@@ -1,0 +1,34 @@
+"""mx_rcnn_tpu — a TPU-native two-stage detection framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capability surface of the
+reference MXNet Faster R-CNN framework (cepera-ang/mx-rcnn): Faster R-CNN /
+Mask R-CNN with VGG-16 and ResNet-50/101 (+FPN) backbones, end-to-end and
+4-step alternate training, PASCAL VOC and COCO datasets.
+
+Design principles (TPU-first, not a port):
+  * Everything in the training step is one jitted XLA program — the
+    reference's per-step host round-trip (``ProposalTarget`` CustomOp,
+    ``rcnn/symbol/proposal_target.py``) is replaced by in-graph, fixed-size
+    masked ops driven by ``jax.random`` keys.
+  * All ragged quantities (gt boxes, proposals, sampled RoIs, NMS output)
+    are statically padded — the reference already proves this contract with
+    its fixed post-NMS padding (2000 train / 300 test rows).
+  * Data parallelism is a ``jax.sharding.Mesh`` + ``shard_map`` with
+    ``lax.psum`` gradient reduction over the ICI axis, replacing
+    ``KVStore('device')``.
+  * Hot non-matmul ops (bitmask NMS, ROIAlign) have Pallas TPU kernels with
+    pure-JAX fallbacks that share a signature and serve as test oracles.
+
+Layer map (mirrors SURVEY.md §1 bottom-to-top):
+  ops/      — numeric core: anchors, box codecs, IoU, NMS, target assignment
+  kernels/  — Pallas TPU kernels for the hot ops
+  models/   — flax backbones + heads + full detector graphs
+  data/     — host-side dataset layer (VOC/COCO), static-shape batching
+  train/    — losses, jitted train step, schedules, metrics, checkpoints
+  eval/     — im_detect / pred_eval, VOC AP, in-repo COCO eval
+  parallel/ — mesh construction and sharding rules
+  utils/    — checkpoint load/save/combine helpers
+  native/   — C++ CPU extension tier (RLE masks, host batch assembly)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,320 @@
+"""Configuration system.
+
+The reference keeps a global mutable ``easydict`` tree (``rcnn/config.py``:
+``config``, ``default``, ``generate_config(network, dataset)``) that every
+layer reads.  Field names and default values below deliberately preserve the
+reference's, so a user of the reference can audit them one-to-one — but the
+container is a frozen dataclass tree: immutable, hashable (so it can be a
+static argument to ``jax.jit``), and assembled by a pure ``generate_config``
+instead of in-place mutation.
+
+Reference parity notes
+----------------------
+* ``TrainConfig`` mirrors ``config.TRAIN.*`` (BATCH_ROIS=128,
+  FG_FRACTION=0.25, RPN_* anchor/NMS params, bbox normalization
+  means/stds, END2END flag).
+* ``TestConfig`` mirrors ``config.TEST.*`` (RPN_PRE/POST_NMS_TOP_N,
+  NMS=0.3, max_per_image).
+* ``generate_config(network, dataset)`` applies the network/dataset preset
+  dicts exactly like the reference's, returning a new frozen config.
+* TPU-specific additions are grouped in their own fields and documented as
+  such (scale buckets replacing ``MutableModule`` rebinding, MAX_GT padding,
+  mesh axes) — they are additive, not renames.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field, replace
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Mirrors reference ``config.TRAIN``."""
+
+    # whether to train RPN+RCNN jointly (train_end2end.py) or staged
+    END2END: bool = True
+    # scale-jitter: pick a random scale index per image (reference: single scale)
+    SHUFFLE: bool = True
+    FLIP: bool = True
+
+    # images per device-step (reference: per-GPU batch from --ctx split)
+    BATCH_IMAGES: int = 1
+    # R-CNN sampled RoIs per image
+    BATCH_ROIS: int = 128
+    FG_FRACTION: float = 0.25
+    FG_THRESH: float = 0.5
+    BG_THRESH_HI: float = 0.5
+    BG_THRESH_LO: float = 0.0
+
+    # bbox regression target normalization (folded into weights at save time,
+    # see train/checkpoint.py — same contract as reference do_checkpoint)
+    BBOX_NORMALIZATION_PRECOMPUTED: bool = True
+    BBOX_MEANS: Tuple[float, float, float, float] = (0.0, 0.0, 0.0, 0.0)
+    BBOX_STDS: Tuple[float, float, float, float] = (0.1, 0.1, 0.2, 0.2)
+
+    # RPN anchor target assignment
+    RPN_FG_FRACTION: float = 0.5
+    RPN_BATCH_SIZE: int = 256
+    RPN_POSITIVE_OVERLAP: float = 0.7
+    RPN_NEGATIVE_OVERLAP: float = 0.3
+    RPN_CLOBBER_POSITIVES: bool = False
+    RPN_ALLOWED_BORDER: int = 0
+
+    # RPN proposal generation (training-time Proposal op params)
+    CXX_PROPOSAL: bool = True  # reference flag name; here: use Pallas kernel
+    RPN_NMS_THRESH: float = 0.7
+    RPN_PRE_NMS_TOP_N: int = 12000
+    RPN_POST_NMS_TOP_N: int = 2000
+    RPN_MIN_SIZE: int = 16
+
+    # optimizer (reference train_end2end defaults)
+    LR: float = 0.001
+    LR_STEP: Tuple[int, ...] = (7,)  # epochs at which lr decays 10x
+    LR_FACTOR: float = 0.1
+    MOMENTUM: float = 0.9
+    WD: float = 0.0005
+    CLIP_GRADIENT: float = 5.0
+    WARMUP: bool = False
+    WARMUP_LR: float = 0.0
+    WARMUP_STEP: int = 0
+
+    # Mask R-CNN
+    MASK_SIZE: int = 28
+
+
+@dataclass(frozen=True)
+class TestConfig:
+    """Mirrors reference ``config.TEST``."""
+
+    HAS_RPN: bool = True
+    BATCH_IMAGES: int = 1
+    CXX_PROPOSAL: bool = True
+    RPN_NMS_THRESH: float = 0.7
+    RPN_PRE_NMS_TOP_N: int = 6000
+    RPN_POST_NMS_TOP_N: int = 300
+    RPN_MIN_SIZE: int = 16
+    # final per-class detection NMS
+    NMS: float = 0.3
+    # score threshold applied in pred_eval
+    THRESH: float = 1e-3
+    MAX_PER_IMAGE: int = 100
+    # proposal-file path mode for alternate training (ROIIter)
+    PROPOSAL: str = "rpn"
+
+
+@dataclass(frozen=True)
+class NetworkConfig:
+    """Mirrors the reference's per-network preset dict
+    (``config.py: network.vgg / network.resnet``)."""
+
+    NETWORK: str = "resnet50"
+    # ImageNet pretrained checkpoint (converted .npz; see utils/load_model.py)
+    PRETRAINED: str = "model/pretrained"
+    PRETRAINED_EPOCH: int = 0
+    PIXEL_MEANS: Tuple[float, float, float] = (123.68, 116.779, 103.939)
+    PIXEL_STDS: Tuple[float, float, float] = (1.0, 1.0, 1.0)
+    IMAGE_STRIDE: int = 32
+    RPN_FEAT_STRIDE: int = 16
+    RCNN_FEAT_STRIDE: int = 16
+    FIXED_PARAMS: Tuple[str, ...] = ("conv1", "bn1", "stage1", "gamma", "beta")
+    FIXED_PARAMS_SHARED: Tuple[str, ...] = ("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta")
+    ANCHOR_SCALES: Tuple[int, ...] = (8, 16, 32)
+    ANCHOR_RATIOS: Tuple[float, ...] = (0.5, 1.0, 2.0)
+    NUM_ANCHORS: int = 9
+    # FPN (capability target per BASELINE.json configs 4-5; not in classic ref)
+    HAS_FPN: bool = False
+    FPN_FEAT_STRIDES: Tuple[int, ...] = (4, 8, 16, 32, 64)
+    FPN_ANCHOR_SCALES: Tuple[int, ...] = (8,)
+    FPN_OUT_CHANNELS: int = 256
+    HAS_MASK: bool = False
+
+
+@dataclass(frozen=True)
+class DatasetConfig:
+    """Mirrors the reference's per-dataset preset dict."""
+
+    DATASET: str = "PascalVOC"
+    IMAGE_SET: str = "2007_trainval"
+    TEST_IMAGE_SET: str = "2007_test"
+    ROOT_PATH: str = "data"
+    DATASET_PATH: str = "data/VOCdevkit"
+    NUM_CLASSES: int = 21  # includes __background__
+
+
+@dataclass(frozen=True)
+class TPUConfig:
+    """TPU-native additions (no reference counterpart; documented divergence).
+
+    The reference handles variable image sizes by rebinding executors
+    (``rcnn/core/module.py: MutableModule``).  Under XLA we instead bucket
+    images into a small set of static padded shapes; each bucket has one
+    compiled program.
+    """
+
+    # (short_side, long_side) scale buckets; first is the reference SCALES[0]
+    SCALES: Tuple[Tuple[int, int], ...] = ((600, 1000),)
+    # padded max gt boxes per image
+    MAX_GT: int = 100
+    # data-parallel mesh axis name and DCN axis for multi-slice
+    MESH_AXIS_DATA: str = "data"
+    MESH_AXIS_MODEL: str = "model"
+    # compute dtype for the backbone (params stay f32)
+    COMPUTE_DTYPE: str = "bfloat16"
+    # host→device prefetch depth
+    PREFETCH: int = 2
+
+
+@dataclass(frozen=True)
+class Config:
+    """Root config. Frozen + hashable → usable as a jit static arg."""
+
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    dataset: DatasetConfig = field(default_factory=DatasetConfig)
+    TRAIN: TrainConfig = field(default_factory=TrainConfig)
+    TEST: TestConfig = field(default_factory=TestConfig)
+    tpu: TPUConfig = field(default_factory=TPUConfig)
+
+    @property
+    def NUM_CLASSES(self) -> int:
+        return self.dataset.NUM_CLASSES
+
+    def replace(self, **kw) -> "Config":
+        return replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Preset registry — the analogue of the reference's `network` / `dataset`
+# easydict preset blocks applied by generate_config().
+# ---------------------------------------------------------------------------
+
+_NETWORK_PRESETS = {
+    "vgg16": dict(
+        NETWORK="vgg16",
+        IMAGE_STRIDE=0,
+        RPN_FEAT_STRIDE=16,
+        RCNN_FEAT_STRIDE=16,
+        FIXED_PARAMS=("conv1", "conv2"),
+        FIXED_PARAMS_SHARED=("conv1", "conv2", "conv3", "conv4", "conv5"),
+        HAS_FPN=False,
+    ),
+    "resnet50": dict(
+        NETWORK="resnet50",
+        IMAGE_STRIDE=32,
+        FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
+        FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta"),
+    ),
+    "resnet101": dict(
+        NETWORK="resnet101",
+        IMAGE_STRIDE=32,
+        FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
+        FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta"),
+    ),
+    "resnet50_fpn": dict(
+        NETWORK="resnet50",
+        IMAGE_STRIDE=32,
+        HAS_FPN=True,
+        RCNN_FEAT_STRIDE=4,
+        FPN_ANCHOR_SCALES=(8,),
+        NUM_ANCHORS=3,
+    ),
+    "resnet101_fpn": dict(
+        NETWORK="resnet101",
+        IMAGE_STRIDE=32,
+        HAS_FPN=True,
+        RCNN_FEAT_STRIDE=4,
+        FPN_ANCHOR_SCALES=(8,),
+        NUM_ANCHORS=3,
+    ),
+    "resnet101_fpn_mask": dict(
+        NETWORK="resnet101",
+        IMAGE_STRIDE=32,
+        HAS_FPN=True,
+        HAS_MASK=True,
+        RCNN_FEAT_STRIDE=4,
+        FPN_ANCHOR_SCALES=(8,),
+        NUM_ANCHORS=3,
+    ),
+}
+
+_DATASET_PRESETS = {
+    "PascalVOC": dict(
+        DATASET="PascalVOC",
+        IMAGE_SET="2007_trainval",
+        TEST_IMAGE_SET="2007_test",
+        ROOT_PATH="data",
+        DATASET_PATH="data/VOCdevkit",
+        NUM_CLASSES=21,
+    ),
+    "PascalVOC0712": dict(
+        DATASET="PascalVOC",
+        IMAGE_SET="2007_trainval+2012_trainval",
+        TEST_IMAGE_SET="2007_test",
+        ROOT_PATH="data",
+        DATASET_PATH="data/VOCdevkit",
+        NUM_CLASSES=21,
+    ),
+    "coco": dict(
+        DATASET="coco",
+        IMAGE_SET="train2017",
+        TEST_IMAGE_SET="val2017",
+        ROOT_PATH="data",
+        DATASET_PATH="data/coco",
+        NUM_CLASSES=81,
+    ),
+}
+
+
+def generate_config(network: str, dataset: str, **overrides) -> Config:
+    """Build a frozen Config from network+dataset preset names.
+
+    Same role as the reference's ``generate_config`` (rcnn/config.py), which
+    mutates the global ``config``/``default`` easydicts in place; here it
+    returns a fresh immutable tree.
+
+    ``overrides`` may address nested fields with double-underscore paths,
+    e.g. ``generate_config('resnet50', 'PascalVOC', TRAIN__BATCH_IMAGES=2)``.
+    """
+    if network not in _NETWORK_PRESETS:
+        raise KeyError(f"unknown network '{network}'; have {sorted(_NETWORK_PRESETS)}")
+    if dataset not in _DATASET_PRESETS:
+        raise KeyError(f"unknown dataset '{dataset}'; have {sorted(_DATASET_PRESETS)}")
+
+    net = NetworkConfig(**_NETWORK_PRESETS[network])
+    ds = DatasetConfig(**_DATASET_PRESETS[dataset])
+    train = TrainConfig()
+    test = TestConfig()
+    tpu = TPUConfig()
+
+    # COCO schedules differ from VOC in the reference scripts
+    if dataset == "coco":
+        train = replace(train, LR_STEP=(6,), BATCH_ROIS=128)
+        tpu = replace(tpu, SCALES=((800, 1333),))
+
+    cfg = Config(network=net, dataset=ds, TRAIN=train, TEST=test, tpu=tpu)
+
+    # apply double-underscore-path overrides
+    for key, val in overrides.items():
+        parts = key.split("__")
+        if len(parts) == 1:
+            cfg = replace(cfg, **{parts[0]: val})
+        elif len(parts) == 2:
+            sub = getattr(cfg, parts[0])
+            cfg = replace(cfg, **{parts[0]: replace(sub, **{parts[1]: val})})
+        else:
+            raise KeyError(f"override path too deep: {key}")
+    return cfg
+
+
+def list_networks():
+    return sorted(_NETWORK_PRESETS)
+
+
+def list_datasets():
+    return sorted(_DATASET_PRESETS)
+
+
+def config_to_dict(cfg: Config) -> dict:
+    """Flatten for logging/serialization."""
+    return dataclasses.asdict(cfg)

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot non-matmul ops.
+
+Each kernel shares a signature with (and is tested against) its pure-JAX
+fallback in ``mx_rcnn_tpu.ops``.  Until a kernel lands, the module exports
+the fallback so every ``use_pallas=True`` call site stays functional.
+"""
+
+from mx_rcnn_tpu.kernels.nms_pallas import nms_pallas

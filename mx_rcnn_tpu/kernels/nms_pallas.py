@@ -1,0 +1,15 @@
+"""Blocked-bitmask NMS Pallas kernel (reference: rcnn/cython/nms_kernel.cu).
+
+Status: fallback wrapper — delegates to the exact pure-JAX greedy NMS in
+``ops.nms.nms_padded`` until the Pallas kernel lands.  The planned kernel
+follows the CUDA bitmask algorithm re-tiled for the TPU VPU: boxes in
+128-wide lanes, per-block pairwise IoU → suppression bitmask in VMEM,
+sequential block scan in SMEM.  Callers must not depend on anything beyond
+the shared signature.
+"""
+
+from mx_rcnn_tpu.ops.nms import nms_padded
+
+
+def nms_pallas(boxes, scores, max_out, iou_thresh, valid=None):
+    return nms_padded(boxes, scores, max_out=max_out, iou_thresh=iou_thresh, valid=valid)

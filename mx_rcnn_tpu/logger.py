@@ -1,0 +1,10 @@
+"""Repo-wide logger (reference: ``rcnn/logger.py`` — module-level logging setup)."""
+
+import logging
+
+logging.basicConfig(
+    format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    level=logging.INFO,
+)
+logger = logging.getLogger("mx_rcnn_tpu")
+logger.setLevel(logging.INFO)

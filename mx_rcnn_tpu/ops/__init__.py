@@ -1,0 +1,21 @@
+"""Numeric core ops.
+
+Pure-function, jittable, fixed-size/masked implementations of every numeric
+contract in the reference's ``rcnn/processing`` + ``rcnn/io`` +
+``rcnn/symbol/{proposal,proposal_target}.py`` layers, rebuilt TPU-first:
+static shapes, vectorized masks instead of boolean indexing, ``jax.random``
+instead of host numpy RNG.
+"""
+
+from mx_rcnn_tpu.ops.anchors import generate_anchors, all_anchors
+from mx_rcnn_tpu.ops.boxes import (
+    bbox_transform,
+    bbox_pred,
+    clip_boxes,
+    bbox_overlaps,
+)
+from mx_rcnn_tpu.ops.nms import nms_padded, nms
+from mx_rcnn_tpu.ops.assign_anchor import assign_anchor
+from mx_rcnn_tpu.ops.sample_rois import sample_rois
+from mx_rcnn_tpu.ops.proposal import propose
+from mx_rcnn_tpu.ops.roi_align import roi_align, roi_pool

@@ -1,0 +1,92 @@
+"""Anchor generation.
+
+Behavioral contract of the reference's ``rcnn/processing/generate_anchor.py``:
+``generate_anchors(base_size=16, ratios=[0.5,1,2], scales=[8,16,32])`` returns
+an (A, 4) array of base anchors produced by enumerating aspect ratios of a
+base_size×base_size box centered at ((base_size-1)/2), then scaling each.
+Box widths/heights use the legacy "+1" convention (w = x2 - x1 + 1), which we
+preserve everywhere for numeric parity with the reference.
+
+Anchors are static given the config → computed in numpy at trace time and
+closed over as constants in the jitted graph (no runtime cost).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _whctrs(anchor: np.ndarray):
+    """width, height, center x, center y of an (x1,y1,x2,y2) anchor."""
+    w = anchor[2] - anchor[0] + 1.0
+    h = anchor[3] - anchor[1] + 1.0
+    x_ctr = anchor[0] + 0.5 * (w - 1.0)
+    y_ctr = anchor[1] + 0.5 * (h - 1.0)
+    return w, h, x_ctr, y_ctr
+
+
+def _mkanchors(ws, hs, x_ctr, y_ctr):
+    ws = ws[:, None]
+    hs = hs[:, None]
+    return np.hstack(
+        (
+            x_ctr - 0.5 * (ws - 1.0),
+            y_ctr - 0.5 * (hs - 1.0),
+            x_ctr + 0.5 * (ws - 1.0),
+            y_ctr + 0.5 * (hs - 1.0),
+        )
+    )
+
+
+def _ratio_enum(anchor, ratios):
+    w, h, x_ctr, y_ctr = _whctrs(anchor)
+    size = w * h
+    size_ratios = size / ratios
+    ws = np.round(np.sqrt(size_ratios))
+    hs = np.round(ws * ratios)
+    return _mkanchors(ws, hs, x_ctr, y_ctr)
+
+
+def _scale_enum(anchor, scales):
+    w, h, x_ctr, y_ctr = _whctrs(anchor)
+    ws = w * scales
+    hs = h * scales
+    return _mkanchors(ws, hs, x_ctr, y_ctr)
+
+
+def generate_anchors(base_size: int = 16, ratios=(0.5, 1.0, 2.0), scales=(8, 16, 32)) -> np.ndarray:
+    """(A, 4) float32 base anchors; A = len(ratios) * len(scales)."""
+    ratios = np.asarray(ratios, dtype=np.float64)
+    scales = np.asarray(scales, dtype=np.float64)
+    base_anchor = np.array([0, 0, base_size - 1, base_size - 1], dtype=np.float64)
+    ratio_anchors = _ratio_enum(base_anchor, ratios)
+    anchors = np.vstack(
+        [_scale_enum(ratio_anchors[i], scales) for i in range(ratio_anchors.shape[0])]
+    )
+    return anchors.astype(np.float32)
+
+
+def all_anchors(
+    feat_h: int,
+    feat_w: int,
+    stride: int,
+    base_anchors: np.ndarray | None = None,
+    **kw,
+) -> np.ndarray:
+    """Slide base anchors over an H×W feature grid (reference: the shift
+    enumeration at the top of ``assign_anchor`` in rcnn/io/rpn.py and of the
+    Proposal op).
+
+    Returns (feat_h * feat_w * A, 4) float32, ordered row-major over the grid
+    with the A anchors contiguous per cell — i.e. index = (y * W + x) * A + a.
+    """
+    if base_anchors is None:
+        base_anchors = generate_anchors(base_size=stride, **kw)
+    A = base_anchors.shape[0]
+    shift_x = np.arange(feat_w, dtype=np.float32) * stride
+    shift_y = np.arange(feat_h, dtype=np.float32) * stride
+    sx, sy = np.meshgrid(shift_x, shift_y)
+    shifts = np.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], axis=1)
+    # (K, 1, 4) + (1, A, 4) → (K, A, 4)
+    anchors = shifts[:, None, :] + base_anchors[None, :, :]
+    return anchors.reshape(-1, 4).astype(np.float32)

@@ -1,0 +1,130 @@
+"""RPN anchor target assignment — in-graph, fixed-size, masked.
+
+Behavioral contract of the reference's ``assign_anchor`` (rcnn/io/rpn.py):
+
+1. slide A base anchors over the feature grid;
+2. only anchors fully inside the image (± allowed_border) participate;
+3. labels: 1 (fg) if IoU ≥ RPN_POSITIVE_OVERLAP with some gt **or** the
+   anchor attains the per-gt max IoU (ties included); 0 (bg) if max IoU <
+   RPN_NEGATIVE_OVERLAP; −1 (ignore) otherwise and for outside anchors;
+4. subsample: at most RPN_FG_FRACTION·RPN_BATCH_SIZE fg and
+   (RPN_BATCH_SIZE − num_fg) bg survive; excess are flipped to −1 at random;
+5. bbox targets = encode(anchor → its argmax gt), weights 1 on fg anchors.
+
+TPU-first divergence (documented): the reference computes this per batch on
+the host in numpy (host hot-loop #1 in SURVEY §3.1); here it is a jittable
+pure function on padded gt boxes, running inside the train step on device,
+with ``jax.random`` subsampling instead of host ``npr.choice``.  Seeds
+differ from the reference by construction, so parity is statistical (mAP),
+not bitwise — same caveat as SURVEY §7 hard-part 3.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.boxes import bbox_overlaps, bbox_transform
+
+
+def _keep_topk_random(mask: jnp.ndarray, k, key) -> jnp.ndarray:
+    """Keep at most k True entries of ``mask``, chosen uniformly.
+
+    Deterministic given the key: ranks a uniform priority and keeps the top-k
+    ranked True entries. k may be a traced scalar.
+    """
+    r = jax.random.uniform(key, mask.shape)
+    r = jnp.where(mask, r, -1.0)
+    # rank[i] = position of i in descending-priority order
+    rank = jnp.argsort(jnp.argsort(-r))
+    return mask & (rank < k)
+
+
+@partial(jax.jit, static_argnames=("batch_size", "fg_fraction",
+                                   "pos_overlap", "neg_overlap", "allowed_border",
+                                   "clobber_positives"))
+def assign_anchor(
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_valid: jnp.ndarray,
+    im_h: jnp.ndarray,
+    im_w: jnp.ndarray,
+    key: jax.Array,
+    *,
+    batch_size: int = 256,
+    fg_fraction: float = 0.5,
+    pos_overlap: float = 0.7,
+    neg_overlap: float = 0.3,
+    allowed_border: int = 0,
+    clobber_positives: bool = False,
+):
+    """Compute RPN labels/targets for one image.
+
+    Args:
+      anchors: (N, 4) all anchors for this feature shape (static constant).
+      gt_boxes: (G, 4) padded gt boxes.
+      gt_valid: (G,) bool validity of each padded row.
+      im_h, im_w: effective (pre-padding) image size, traced scalars.
+      key: jax PRNG key for fg/bg subsampling.
+
+    Returns dict with:
+      label: (N,) int32 ∈ {−1, 0, 1}
+      bbox_target: (N, 4) float32
+      bbox_weight: (N, 4) float32 (1 on fg rows)
+    """
+    n = anchors.shape[0]
+    num_fg_cap = int(batch_size * fg_fraction)
+
+    inside = (
+        (anchors[:, 0] >= -allowed_border)
+        & (anchors[:, 1] >= -allowed_border)
+        & (anchors[:, 2] < im_w + allowed_border)
+        & (anchors[:, 3] < im_h + allowed_border)
+    )
+
+    # IoU against padded gt; invalid gt columns masked to -1 so they never win
+    overlaps = bbox_overlaps(anchors, gt_boxes)  # (N, G)
+    overlaps = jnp.where(gt_valid[None, :], overlaps, -1.0)
+
+    any_gt = jnp.any(gt_valid)
+    max_overlap = jnp.max(overlaps, axis=1)  # (N,)
+    argmax_gt = jnp.argmax(overlaps, axis=1)  # (N,)
+
+    # per-gt max over *inside* anchors; an anchor tying the per-gt max is fg
+    ov_inside = jnp.where(inside[:, None], overlaps, -1.0)
+    gt_max = jnp.max(ov_inside, axis=0)  # (G,)
+    is_gt_argmax = jnp.any(
+        (ov_inside == gt_max[None, :]) & gt_valid[None, :] & (gt_max[None, :] > 0), axis=1
+    )
+
+    fg = (max_overlap >= pos_overlap) | is_gt_argmax
+    bg = max_overlap < neg_overlap
+    if clobber_positives:
+        fg = fg & ~bg
+    else:
+        bg = bg & ~fg
+    # no gt in image → everything eligible is bg (reference: labels[:] = 0)
+    fg = fg & any_gt & inside
+    bg = jnp.where(any_gt, bg, True) & inside
+
+    # subsample
+    k_fg, k_bg = jax.random.split(key)
+    fg_kept = _keep_topk_random(fg, num_fg_cap, k_fg)
+    num_fg = jnp.sum(fg_kept)
+    bg_kept = _keep_topk_random(bg, batch_size - num_fg, k_bg)
+
+    label = jnp.full((n,), -1, dtype=jnp.int32)
+    label = jnp.where(bg_kept, 0, label)
+    label = jnp.where(fg_kept, 1, label)
+
+    matched_gt = gt_boxes[argmax_gt]  # (N, 4)
+    bbox_target = bbox_transform(anchors, matched_gt).astype(jnp.float32)
+    bbox_target = jnp.where(any_gt, bbox_target, jnp.zeros_like(bbox_target))
+    bbox_weight = jnp.where(fg_kept[:, None], 1.0, 0.0).astype(jnp.float32)
+    # zero targets on non-fg rows for cleanliness (reference leaves garbage,
+    # masked by weights; zeros keep grads identical and debugging saner)
+    bbox_target = bbox_target * bbox_weight
+
+    return {"label": label, "bbox_target": bbox_target, "bbox_weight": bbox_weight}

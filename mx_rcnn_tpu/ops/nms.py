@@ -1,0 +1,111 @@
+"""Non-maximum suppression.
+
+The reference ships three NMS backends (``rcnn/processing/nms.py`` wrapping
+``rcnn/cython/cpu_nms.pyx`` and the CUDA bitmask kernel in
+``rcnn/cython/nms_kernel.cu``), all implementing the same greedy
+suppress-by-IoU contract.  Here:
+
+* ``nms_padded`` — exact greedy NMS as a jittable, fixed-output-size op.
+  Formulated as a scan over *output slots* (post-NMS count, 300–2000)
+  rather than over input boxes (6000–12000): each step argmaxes the live
+  scores, emits that index, and suppresses its IoU neighborhood with one
+  vectorized pass.  O(max_out · N) work, O(N) memory, no N×N matrix.
+  This is the pure-JAX reference path; ``kernels/nms_pallas.py`` provides
+  the blocked-bitmask Pallas kernel (the CUDA kernel's algorithm, re-tiled
+  for 8×128 vregs) behind the same signature.
+* ``nms`` — host-side numpy greedy NMS matching the reference's
+  ``py_nms_wrapper`` contract, for the (off-hot-path) eval loop.
+
+Greedy NMS tie/threshold semantics follow the reference: a box is
+suppressed when IoU > thresh (strict) w.r.t. a kept box; legacy "+1" areas.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG = -1e10
+
+
+def _iou_one_many(box: jnp.ndarray, boxes: jnp.ndarray) -> jnp.ndarray:
+    """IoU of one (4,) box against (N,4) boxes, legacy +1 convention."""
+    iw = jnp.minimum(box[2], boxes[:, 2]) - jnp.maximum(box[0], boxes[:, 0]) + 1.0
+    ih = jnp.minimum(box[3], boxes[:, 3]) - jnp.maximum(box[1], boxes[:, 1]) + 1.0
+    inter = jnp.maximum(iw, 0.0) * jnp.maximum(ih, 0.0)
+    area1 = (box[2] - box[0] + 1.0) * (box[3] - box[1] + 1.0)
+    areas = (boxes[:, 2] - boxes[:, 0] + 1.0) * (boxes[:, 3] - boxes[:, 1] + 1.0)
+    return inter / jnp.maximum(area1 + areas - inter, 1e-14)
+
+
+def nms_padded(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    max_out: int,
+    iou_thresh: float,
+    valid: jnp.ndarray | None = None,
+):
+    """Exact greedy NMS with static output size.
+
+    Args:
+      boxes: (N, 4) float.
+      scores: (N,) float.
+      max_out: static number of output slots.
+      iou_thresh: suppression threshold (suppress when IoU > thresh).
+      valid: optional (N,) bool; False rows can never be selected.
+
+    Returns:
+      keep_idx: (max_out,) int32 indices into boxes; padded slots hold 0.
+      keep_mask: (max_out,) bool; True where the slot holds a real kept box.
+
+    Selection order (and therefore the padded prefix) is score-descending,
+    matching the reference's argsort-then-suppress loop.
+    """
+    n = boxes.shape[0]
+    live = scores.astype(jnp.float32)
+    if valid is not None:
+        live = jnp.where(valid, live, _NEG)
+
+    def body(live_scores, _):
+        i = jnp.argmax(live_scores)
+        ok = live_scores[i] > _NEG / 2
+        iou = _iou_one_many(boxes[i], boxes)
+        # suppress the neighborhood of the selected box (includes itself,
+        # IoU=1) — only if the selection was real, else leave state untouched
+        suppress = iou > iou_thresh
+        new_scores = jnp.where(suppress & ok, _NEG, live_scores)
+        # also retire the selected box even if iou_thresh >= 1
+        new_scores = jnp.where(ok, new_scores.at[i].set(_NEG), new_scores)
+        return new_scores, (jnp.where(ok, i, 0).astype(jnp.int32), ok)
+
+    _, (keep_idx, keep_mask) = jax.lax.scan(body, live, None, length=max_out)
+    return keep_idx, keep_mask
+
+
+def nms(dets: np.ndarray, thresh: float) -> list:
+    """Host numpy greedy NMS over (N, 5) [x1,y1,x2,y2,score] rows.
+
+    Same contract as the reference's py_nms/cpu_nms wrappers; used by the
+    eval loop (``eval/tester.py``) which runs off-device.
+    """
+    if dets.size == 0:
+        return []
+    x1, y1, x2, y2, scores = dets[:, 0], dets[:, 1], dets[:, 2], dets[:, 3], dets[:, 4]
+    areas = (x2 - x1 + 1) * (y2 - y1 + 1)
+    order = scores.argsort()[::-1]
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(int(i))
+        xx1 = np.maximum(x1[i], x1[order[1:]])
+        yy1 = np.maximum(y1[i], y1[order[1:]])
+        xx2 = np.minimum(x2[i], x2[order[1:]])
+        yy2 = np.minimum(y2[i], y2[order[1:]])
+        w = np.maximum(0.0, xx2 - xx1 + 1)
+        h = np.maximum(0.0, yy2 - yy1 + 1)
+        inter = w * h
+        ovr = inter / (areas[i] + areas[order[1:]] - inter)
+        inds = np.where(ovr <= thresh)[0]
+        order = order[inds + 1]
+    return keep

@@ -1,0 +1,93 @@
+"""Proposal generation (RPN output → RoIs) — the reference's ``Proposal`` op.
+
+Behavioral contract (rcnn/symbol/proposal.py CustomOp, and MXNet's C++/CUDA
+``mx.contrib.sym.Proposal`` selected by config.CXX_PROPOSAL):
+
+1. decode per-anchor deltas into boxes (bbox_pred), clip to the image;
+2. drop boxes smaller than min_size · im_scale on either side;
+3. keep the top pre_nms_top_n by fg score (12000 train / 6000 test);
+4. greedy NMS at 0.7;
+5. keep the top post_nms_top_n (2000 train / 300 test), padding the output
+   to that static size — the reference pads by duplicating kept boxes
+   (npr.choice over keep); we return an explicit validity mask instead and
+   duplicate-pad, which downstream masked ops consume directly.
+
+Non-differentiable by contract (reference backward is zeros): callers wrap
+the output in ``stop_gradient``.
+
+This is a jitted device-side op; the NMS inside is ``ops.nms.nms_padded``
+(pure JAX) or the Pallas bitmask kernel (kernels/nms_pallas.py) chosen by
+``use_pallas``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
+from mx_rcnn_tpu.ops.nms import nms_padded
+
+
+@partial(jax.jit, static_argnames=("pre_nms_top_n", "post_nms_top_n", "nms_thresh",
+                                   "min_size", "use_pallas"))
+def propose(
+    scores: jnp.ndarray,
+    bbox_deltas: jnp.ndarray,
+    anchors: jnp.ndarray,
+    im_h: jnp.ndarray,
+    im_w: jnp.ndarray,
+    im_scale: jnp.ndarray,
+    *,
+    pre_nms_top_n: int = 6000,
+    post_nms_top_n: int = 300,
+    nms_thresh: float = 0.7,
+    min_size: int = 16,
+    use_pallas: bool = False,
+):
+    """Generate proposals for one image.
+
+    Args:
+      scores: (N,) per-anchor foreground probability (already sliced from the
+        2-way softmax, matching the reference's ``scores[:, A:, :, :]``).
+      bbox_deltas: (N, 4) per-anchor regression output.
+      anchors: (N, 4) anchor boxes for this feature shape.
+      im_h, im_w, im_scale: effective image size and resize scale (traced).
+
+    Returns:
+      rois: (post_nms_top_n, 4) float32, duplicate-padded.
+      roi_scores: (post_nms_top_n,) float32.
+      roi_valid: (post_nms_top_n,) bool.
+    """
+    n = scores.shape[0]
+    boxes = bbox_pred(anchors, bbox_deltas)
+    boxes = clip_boxes(boxes, im_h, im_w)
+
+    ws = boxes[:, 2] - boxes[:, 0] + 1.0
+    hs = boxes[:, 3] - boxes[:, 1] + 1.0
+    ms = min_size * im_scale
+    size_ok = (ws >= ms) & (hs >= ms)
+    scores = jnp.where(size_ok, scores, -1.0)
+
+    k = min(pre_nms_top_n, n)
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    top_boxes = boxes[top_idx]
+    top_valid = top_scores > -0.5
+
+    if use_pallas:
+        from mx_rcnn_tpu.kernels.nms_pallas import nms_pallas
+        keep_idx, keep_mask = nms_pallas(
+            top_boxes, top_scores, max_out=post_nms_top_n,
+            iou_thresh=nms_thresh, valid=top_valid)
+    else:
+        keep_idx, keep_mask = nms_padded(
+            top_boxes, top_scores, max_out=post_nms_top_n,
+            iou_thresh=nms_thresh, valid=top_valid)
+
+    rois = top_boxes[keep_idx]
+    roi_scores = jnp.where(keep_mask, top_scores[keep_idx], 0.0)
+    # duplicate-pad: invalid slots point at keep_idx 0 (the top box) already,
+    # because nms_padded emits index 0 for empty slots; mask tells the truth.
+    return rois, roi_scores, keep_mask

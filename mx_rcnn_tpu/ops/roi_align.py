@@ -1,0 +1,115 @@
+"""ROIAlign / ROIPool — pure-JAX reference implementations.
+
+The reference's RoI feature extractor is MXNet's CUDA ``ROIPooling``
+(roi_pooling.cu; 7×7 max pool, spatial_scale 1/16, coordinate rounding).
+The Mask R-CNN capability target uses ROIAlign (bilinear, no rounding).
+
+TPU-first design: both are expressed as dense bilinear gathers with a
+*static* sample grid — (R, P, P, S, S) sample points per RoI — which XLA
+lowers to vectorized gathers; no dynamic shapes, no per-RoI loops.  ROIPool
+is realized as max over the same static sample grid (documented divergence:
+the reference's exact integer-binned max-pool has data-dependent bin
+extents which are hostile to static shapes; a dense 4-sample-per-bin max is
+the standard TPU substitute and is accuracy-neutral-or-better, like
+ROIAlign itself).  ``kernels/roi_align_pallas.py`` provides the fused
+Pallas kernel behind the same signature.
+
+Coordinate semantics follow ROIAlign (Mask R-CNN paper): continuous
+coordinates, half-pixel centers, sampling_ratio points per bin axis,
+average (align) or max (pool) reduction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _bilinear(feat: jnp.ndarray, y: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Bilinear sample feat (H, W, C) at (…,) y/x grids → (…, C).
+
+    Out-of-range points contribute 0 (matches ROIAlign's behavior of
+    skipping samples outside the feature map).
+    """
+    h, w, _ = feat.shape
+    in_range = (y > -1.0) & (y < h) & (x > -1.0) & (x < w)
+    y = jnp.clip(y, 0.0, h - 1.0)
+    x = jnp.clip(x, 0.0, w - 1.0)
+
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1 = jnp.minimum(y0 + 1, h - 1.0)
+    x1 = jnp.minimum(x0 + 1, w - 1.0)
+    ly = (y - y0)[..., None]
+    lx = (x - x0)[..., None]
+    hy = 1.0 - ly
+    hx = 1.0 - lx
+
+    y0i, x0i, y1i, x1i = y0.astype(jnp.int32), x0.astype(jnp.int32), y1.astype(jnp.int32), x1.astype(jnp.int32)
+    v00 = feat[y0i, x0i]
+    v01 = feat[y0i, x1i]
+    v10 = feat[y1i, x0i]
+    v11 = feat[y1i, x1i]
+    out = hy * hx * v00 + hy * lx * v01 + ly * hx * v10 + ly * lx * v11
+    return jnp.where(in_range[..., None], out, 0.0)
+
+
+def _roi_sample_grid(roi: jnp.ndarray, spatial_scale: float, pooled: int, sampling: int):
+    """Sample point grid for one RoI → (pooled, pooled, sampling, sampling) y/x."""
+    x1 = roi[0] * spatial_scale
+    y1 = roi[1] * spatial_scale
+    x2 = roi[2] * spatial_scale
+    y2 = roi[3] * spatial_scale
+    roi_w = jnp.maximum(x2 - x1, 1.0)
+    roi_h = jnp.maximum(y2 - y1, 1.0)
+    bin_w = roi_w / pooled
+    bin_h = roi_h / pooled
+
+    py = jnp.arange(pooled, dtype=jnp.float32)
+    px = jnp.arange(pooled, dtype=jnp.float32)
+    sy = (jnp.arange(sampling, dtype=jnp.float32) + 0.5) / sampling
+    sx = (jnp.arange(sampling, dtype=jnp.float32) + 0.5) / sampling
+
+    ys = y1 + (py[:, None, None, None] + sy[None, None, :, None]) * bin_h
+    xs = x1 + (px[None, :, None, None] + sx[None, None, None, :]) * bin_w
+    ys = jnp.broadcast_to(ys, (pooled, pooled, sampling, sampling))
+    xs = jnp.broadcast_to(xs, (pooled, pooled, sampling, sampling))
+    return ys, xs
+
+
+@partial(jax.jit, static_argnames=("pooled_size", "sampling_ratio", "spatial_scale", "mode"))
+def roi_align(
+    features: jnp.ndarray,
+    rois: jnp.ndarray,
+    *,
+    spatial_scale: float = 1.0 / 16,
+    pooled_size: int = 7,
+    sampling_ratio: int = 2,
+    mode: str = "avg",
+) -> jnp.ndarray:
+    """ROIAlign over one feature map.
+
+    Args:
+      features: (H, W, C) — NHWC without batch; callers vmap over batch.
+      rois: (R, 4) boxes in *image* coordinates.
+
+    Returns: (R, pooled, pooled, C).
+    """
+    def one(roi):
+        ys, xs = _roi_sample_grid(roi, spatial_scale, pooled_size, sampling_ratio)
+        vals = _bilinear(features, ys, xs)  # (P, P, S, S, C)
+        if mode == "avg":
+            return vals.mean(axis=(2, 3))
+        return vals.max(axis=(2, 3))
+
+    return jax.vmap(one)(rois)
+
+
+def roi_pool(features, rois, *, spatial_scale=1.0 / 16, pooled_size: int = 7,
+             sampling_ratio: int = 2):
+    """ROIPool compatibility wrapper: max reduction over the static grid."""
+    return roi_align(features, rois, spatial_scale=spatial_scale,
+                     pooled_size=pooled_size, sampling_ratio=sampling_ratio,
+                     mode="max")

@@ -1,0 +1,139 @@
+"""RoI sampling + target assignment for the R-CNN head — in-graph, fixed-size.
+
+Behavioral contract of the reference's ``sample_rois`` (rcnn/io/rcnn.py) as
+invoked by the ``ProposalTarget`` CustomOp (rcnn/symbol/proposal_target.py):
+
+1. gt boxes are appended to the incoming proposals (done by the caller,
+   see ops/proposal.py: the detector graph concatenates them);
+2. each RoI is matched to its argmax-IoU gt; its label is that gt's class;
+3. fg candidates: IoU ≥ FG_THRESH; at most BATCH_ROIS·FG_FRACTION sampled;
+4. bg candidates: IoU ∈ [BG_THRESH_LO, BG_THRESH_HI); fill the remaining
+   slots, sampling **with replacement** when there are too few (the
+   reference uses npr.choice(replace=True) — we cycle the ranked candidate
+   list, same multiset semantics);
+5. output exactly BATCH_ROIS rows: rois, label (0 = background), and
+   class-specific bbox targets/weights in the 4·K layout
+   (``expand_bbox_regression_targets``), optionally normalized by
+   BBOX_MEANS/STDS.
+
+The reference runs this on host numpy inside the training graph **every
+step** (the device→host→device crossing called out in SURVEY §3.1).  Here it
+is a jitted function on device; RNG via ``jax.random``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.boxes import bbox_overlaps, bbox_transform
+
+
+@partial(jax.jit, static_argnames=("num_classes", "batch_rois", "fg_fraction",
+                                   "fg_thresh", "bg_thresh_hi", "bg_thresh_lo"))
+def sample_rois(
+    rois: jnp.ndarray,
+    roi_valid: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_classes: jnp.ndarray,
+    gt_valid: jnp.ndarray,
+    key: jax.Array,
+    *,
+    num_classes: int,
+    batch_rois: int = 128,
+    fg_fraction: float = 0.25,
+    fg_thresh: float = 0.5,
+    bg_thresh_hi: float = 0.5,
+    bg_thresh_lo: float = 0.0,
+    bbox_means=(0.0, 0.0, 0.0, 0.0),
+    bbox_stds=(0.1, 0.1, 0.2, 0.2),
+):
+    """Sample BATCH_ROIS rois for one image.
+
+    Args:
+      rois: (R, 4) padded proposals (gt already appended by caller).
+      roi_valid: (R,) bool.
+      gt_boxes: (G, 4) padded. gt_classes: (G,) int. gt_valid: (G,) bool.
+      key: PRNG key.
+
+    Returns dict with:
+      rois: (batch_rois, 4)
+      label: (batch_rois,) int32 (0 = bg; padded/unfillable slots → 0 with
+             zero loss weight via ``label_weight``)
+      label_weight: (batch_rois,) float32 — 0 only when the image had no
+             usable candidates at all (degenerate), else 1.
+      bbox_target: (batch_rois, 4·num_classes) float32 (normalized)
+      bbox_weight: (batch_rois, 4·num_classes) float32
+    """
+    fg_rois_cap = int(round(batch_rois * fg_fraction))
+
+    overlaps = bbox_overlaps(rois, gt_boxes)  # (R, G)
+    overlaps = jnp.where(gt_valid[None, :] & roi_valid[:, None], overlaps, -1.0)
+    max_ov = jnp.max(overlaps, axis=1)
+    argmax_gt = jnp.argmax(overlaps, axis=1)
+
+    fg_mask = (max_ov >= fg_thresh) & roi_valid
+    bg_mask = (max_ov < bg_thresh_hi) & (max_ov >= bg_thresh_lo) & roi_valid & ~fg_mask
+    # reference fallback: images with no in-range bg fall back to any non-fg
+    # valid roi, so the batch always fills
+    no_bg = ~jnp.any(bg_mask)
+    bg_mask = jnp.where(no_bg, roi_valid & ~fg_mask, bg_mask)
+
+    kf, kb = jax.random.split(key)
+
+    def ranked(mask, k):
+        r = jax.random.uniform(k, mask.shape)
+        r = jnp.where(mask, r, -1.0)
+        return jnp.argsort(-r)  # candidates first, in random order
+
+    fg_order = ranked(fg_mask, kf)  # (R,)
+    bg_order = ranked(bg_mask, kb)
+    fg_count = jnp.sum(fg_mask)
+    bg_count = jnp.sum(bg_mask)
+
+    num_fg = jnp.minimum(fg_count, fg_rois_cap)
+    slots = jnp.arange(batch_rois)
+
+    # slot i < num_fg → i-th ranked fg; else cycle the ranked bg list
+    # (with-replacement fill, matching npr.choice(replace=True)); if the
+    # image has no bg at all, cycle fg instead so every slot is real.
+    bg_slot = (slots - num_fg) % jnp.maximum(bg_count, 1)
+    fg_cycle = slots % jnp.maximum(fg_count, 1)
+    take_fg = slots < num_fg
+    any_bg = bg_count > 0
+    idx = jnp.where(take_fg, fg_order[jnp.minimum(slots, fg_order.shape[0] - 1)],
+                    jnp.where(any_bg, bg_order[bg_slot], fg_order[fg_cycle]))
+    is_fg = take_fg | (~any_bg & (fg_count > 0))
+
+    sampled_rois = rois[idx]
+    sampled_gt_idx = argmax_gt[idx]
+    sampled_label = jnp.where(is_fg, gt_classes[sampled_gt_idx], 0).astype(jnp.int32)
+
+    degenerate = (fg_count + bg_count) == 0
+    label_weight = jnp.where(degenerate, 0.0, 1.0) * jnp.ones((batch_rois,), jnp.float32)
+
+    # class-specific 4K bbox targets (expand_bbox_regression_targets layout)
+    raw_target = bbox_transform(sampled_rois, gt_boxes[sampled_gt_idx])
+    means = jnp.asarray(bbox_means, jnp.float32)
+    stds = jnp.asarray(bbox_stds, jnp.float32)
+    raw_target = (raw_target - means) / stds
+
+    k4 = 4 * num_classes
+    col = sampled_label[:, None] * 4 + jnp.arange(4)[None, :]  # (B, 4)
+    onehot_cols = jax.nn.one_hot(col, k4, dtype=jnp.float32)  # (B, 4, 4K)
+    bbox_target = jnp.einsum("bf,bfk->bk", raw_target.astype(jnp.float32), onehot_cols)
+    fg_w = (is_fg & (sampled_label > 0)).astype(jnp.float32)[:, None, None]
+    bbox_weight = jnp.sum(onehot_cols * fg_w, axis=1)
+    bbox_target = bbox_target * bbox_weight
+
+    return {
+        "rois": sampled_rois,
+        "label": sampled_label,
+        "label_weight": label_weight,
+        "bbox_target": bbox_target,
+        "bbox_weight": bbox_weight,
+        "gt_index": sampled_gt_idx,   # for the mask head's target crop
+        "is_fg": is_fg,
+    }

@@ -1,0 +1,22 @@
+"""Test config: force an 8-device virtual CPU platform before jax imports.
+
+Mirrors SURVEY.md §4's rebuild test pyramid: all unit/sharding tests run on
+CPU with XLA_FLAGS=--xla_force_host_platform_device_count=8 so the data-
+parallel mesh is exercised without a TPU pod.  Bench (bench.py) runs on the
+real chip outside pytest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

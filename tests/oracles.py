@@ -1,0 +1,174 @@
+"""Independent numpy oracle implementations of the reference contracts.
+
+These are written directly from the classic Faster R-CNN algorithm
+descriptions (SURVEY.md §2 behavioral contracts) in plain numpy with
+boolean indexing and python loops — deliberately *not* sharing any code
+with mx_rcnn_tpu.ops — so that each jittable op is tested against an
+independently-derived implementation.
+"""
+
+import numpy as np
+
+
+def generate_anchors_oracle(base_size=16, ratios=(0.5, 1, 2), scales=(8, 16, 32)):
+    anchors = []
+    w0 = h0 = float(base_size)
+    x_ctr = (base_size - 1) / 2.0
+    y_ctr = (base_size - 1) / 2.0
+    size = w0 * h0
+    for r in ratios:
+        ws = round(np.sqrt(size / r))
+        hs = round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            anchors.append([x_ctr - (w - 1) / 2.0, y_ctr - (h - 1) / 2.0,
+                            x_ctr + (w - 1) / 2.0, y_ctr + (h - 1) / 2.0])
+    return np.array(anchors, dtype=np.float32)
+
+
+def iou_oracle(boxes, query):
+    n, k = len(boxes), len(query)
+    out = np.zeros((n, k), dtype=np.float64)
+    for i in range(n):
+        for j in range(k):
+            ix1 = max(boxes[i, 0], query[j, 0])
+            iy1 = max(boxes[i, 1], query[j, 1])
+            ix2 = min(boxes[i, 2], query[j, 2])
+            iy2 = min(boxes[i, 3], query[j, 3])
+            iw = max(0.0, ix2 - ix1 + 1)
+            ih = max(0.0, iy2 - iy1 + 1)
+            inter = iw * ih
+            a1 = (boxes[i, 2] - boxes[i, 0] + 1) * (boxes[i, 3] - boxes[i, 1] + 1)
+            a2 = (query[j, 2] - query[j, 0] + 1) * (query[j, 3] - query[j, 1] + 1)
+            out[i, j] = inter / (a1 + a2 - inter)
+    return out
+
+
+def bbox_transform_oracle(ex, gt):
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * (ew - 1)
+    ecy = ex[:, 1] + 0.5 * (eh - 1)
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * (gw - 1)
+    gcy = gt[:, 1] + 0.5 * (gh - 1)
+    return np.stack([(gcx - ecx) / ew, (gcy - ecy) / eh,
+                     np.log(gw / ew), np.log(gh / eh)], axis=1)
+
+
+def bbox_pred_oracle(boxes, deltas):
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1)
+    cy = boxes[:, 1] + 0.5 * (h - 1)
+    preds = np.zeros_like(deltas)
+    for k in range(deltas.shape[1] // 4):
+        dx, dy, dw, dh = deltas[:, 4 * k], deltas[:, 4 * k + 1], deltas[:, 4 * k + 2], deltas[:, 4 * k + 3]
+        pcx = dx * w + cx
+        pcy = dy * h + cy
+        pw = np.exp(dw) * w
+        ph = np.exp(dh) * h
+        preds[:, 4 * k] = pcx - 0.5 * (pw - 1)
+        preds[:, 4 * k + 1] = pcy - 0.5 * (ph - 1)
+        preds[:, 4 * k + 2] = pcx + 0.5 * (pw - 1)
+        preds[:, 4 * k + 3] = pcy + 0.5 * (ph - 1)
+    return preds
+
+
+def nms_oracle(boxes, scores, thresh):
+    """Greedy NMS; returns kept indices in score-descending order.
+
+    Uses a precomputed IoU matrix (vectorized, still independent of the
+    op under test) so the oracle doesn't dominate suite runtime.
+    """
+    n = len(boxes)
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = (x2 - x1 + 1) * (y2 - y1 + 1)
+    iw = np.maximum(0.0, np.minimum(x2[:, None], x2[None, :]) - np.maximum(x1[:, None], x1[None, :]) + 1)
+    ih = np.maximum(0.0, np.minimum(y2[:, None], y2[None, :]) - np.maximum(y1[:, None], y1[None, :]) + 1)
+    inter = iw * ih
+    iou = inter / (areas[:, None] + areas[None, :] - inter)
+    order = np.argsort(-scores, kind="stable")
+    keep = []
+    suppressed = np.zeros(n, dtype=bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(int(i))
+        suppressed |= iou[i] > thresh
+        suppressed[i] = True
+    return keep
+
+
+def assign_anchor_oracle(anchors, gt, im_h, im_w, pos=0.7, neg=0.3):
+    """Labels only (no subsampling — subsampling is RNG-dependent):
+    1 fg / 0 bg / -1 ignore, per the reference rules."""
+    n = len(anchors)
+    inside = ((anchors[:, 0] >= 0) & (anchors[:, 1] >= 0)
+              & (anchors[:, 2] < im_w) & (anchors[:, 3] < im_h))
+    labels = np.full(n, -1.0)
+    if len(gt) == 0:
+        labels[inside] = 0
+        return labels
+    ov = iou_oracle(anchors[inside], gt)
+    max_ov = ov.max(axis=1)
+    labels_in = np.full(inside.sum(), -1.0)
+    labels_in[max_ov < neg] = 0
+    gt_max = ov.max(axis=0)
+    for g in range(len(gt)):
+        if gt_max[g] > 0:
+            labels_in[ov[:, g] == gt_max[g]] = 1
+    labels_in[max_ov >= pos] = 1
+    labels[inside] = labels_in
+    return labels
+
+
+def propose_oracle(scores, deltas, anchors, im_h, im_w, im_scale,
+                   pre_nms, post_nms, nms_thresh, min_size):
+    """Reference proposal pipeline, returns (rois, scores) kept in order."""
+    boxes = bbox_pred_oracle(anchors, deltas)
+    boxes[:, 0::4] = np.clip(boxes[:, 0::4], 0, im_w - 1)
+    boxes[:, 1::4] = np.clip(boxes[:, 1::4], 0, im_h - 1)
+    boxes[:, 2::4] = np.clip(boxes[:, 2::4], 0, im_w - 1)
+    boxes[:, 3::4] = np.clip(boxes[:, 3::4], 0, im_h - 1)
+    ws = boxes[:, 2] - boxes[:, 0] + 1
+    hs = boxes[:, 3] - boxes[:, 1] + 1
+    keep = np.where((ws >= min_size * im_scale) & (hs >= min_size * im_scale))[0]
+    boxes, scores = boxes[keep], scores[keep]
+    order = np.argsort(-scores, kind="stable")[:pre_nms]
+    boxes, scores = boxes[order], scores[order]
+    keep = nms_oracle(boxes, scores, nms_thresh)[:post_nms]
+    return boxes[keep], scores[keep]
+
+
+def roi_align_oracle(feat, rois, spatial_scale, pooled, sampling):
+    """Loop-based ROIAlign (avg), half-pixel-free legacy-corner semantics
+    matching ops/roi_align.py's documented coordinate contract."""
+    h, w, c = feat.shape
+    out = np.zeros((len(rois), pooled, pooled, c), dtype=np.float64)
+    for r, roi in enumerate(rois):
+        x1, y1, x2, y2 = [v * spatial_scale for v in roi]
+        rw = max(x2 - x1, 1.0)
+        rh = max(y2 - y1, 1.0)
+        bw, bh = rw / pooled, rh / pooled
+        for py in range(pooled):
+            for px in range(pooled):
+                acc = np.zeros(c)
+                for iy in range(sampling):
+                    for ix in range(sampling):
+                        y = y1 + (py + (iy + 0.5) / sampling) * bh
+                        x = x1 + (px + (ix + 0.5) / sampling) * bw
+                        if y <= -1.0 or y >= h or x <= -1.0 or x >= w:
+                            continue
+                        yy = min(max(y, 0.0), h - 1.0)
+                        xx = min(max(x, 0.0), w - 1.0)
+                        y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+                        y1i, x1i = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+                        ly, lx = yy - y0, xx - x0
+                        acc += ((1 - ly) * (1 - lx) * feat[y0, x0]
+                                + (1 - ly) * lx * feat[y0, x1i]
+                                + ly * (1 - lx) * feat[y1i, x0]
+                                + ly * lx * feat[y1i, x1i])
+                out[r, py, px] = acc / (sampling * sampling)
+    return out

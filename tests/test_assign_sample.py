@@ -1,0 +1,175 @@
+"""assign_anchor + sample_rois contract tests (SURVEY §2 rows rpn.py/rcnn.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.anchors import generate_anchors, all_anchors
+from mx_rcnn_tpu.ops.assign_anchor import assign_anchor
+from mx_rcnn_tpu.ops.sample_rois import sample_rois
+from tests import oracles
+
+MAX_GT = 8
+
+
+def _setup(rng, n_gt=3, fh=10, fw=12, stride=16):
+    # small scales so a useful fraction of anchors is inside the tiny test image
+    anchors = all_anchors(fh, fw, stride, generate_anchors(scales=(1, 2, 4)))
+    im_h, im_w = fh * stride, fw * stride
+    gt = np.zeros((MAX_GT, 4), np.float32)
+    for i in range(n_gt):
+        x1, y1 = rng.rand(2) * np.array([im_w - 80, im_h - 80])
+        gt[i] = [x1, y1, x1 + 20 + rng.rand() * 60, y1 + 20 + rng.rand() * 60]
+    valid = np.arange(MAX_GT) < n_gt
+    return anchors, gt, valid, im_h, im_w
+
+
+def test_assign_anchor_labels_match_oracle(rng):
+    anchors, gt, valid, im_h, im_w = _setup(rng)
+    out = assign_anchor(
+        jnp.asarray(anchors), jnp.asarray(gt), jnp.asarray(valid),
+        jnp.float32(im_h), jnp.float32(im_w), jax.random.PRNGKey(0),
+        batch_size=100000, fg_fraction=1.0,
+    )  # huge batch → no subsampling, raw labels comparable
+    got = np.asarray(out["label"])
+    want = oracles.assign_anchor_oracle(anchors, gt[valid], im_h, im_w)
+    np.testing.assert_array_equal(got, want.astype(np.int32))
+
+
+def test_assign_anchor_subsampling_counts(rng):
+    anchors, gt, valid, im_h, im_w = _setup(rng, n_gt=5)
+    out = assign_anchor(
+        jnp.asarray(anchors), jnp.asarray(gt), jnp.asarray(valid),
+        jnp.float32(im_h), jnp.float32(im_w), jax.random.PRNGKey(1),
+        batch_size=256, fg_fraction=0.5,
+    )
+    label = np.asarray(out["label"])
+    n_fg = (label == 1).sum()
+    n_bg = (label == 0).sum()
+    assert n_fg <= 128
+    assert n_fg + n_bg <= 256
+    # plenty of bg anchors exist in a 120-cell grid → batch should fill
+    assert n_fg + n_bg == 256
+
+
+def test_assign_anchor_weights_only_on_fg(rng):
+    anchors, gt, valid, im_h, im_w = _setup(rng)
+    out = assign_anchor(
+        jnp.asarray(anchors), jnp.asarray(gt), jnp.asarray(valid),
+        jnp.float32(im_h), jnp.float32(im_w), jax.random.PRNGKey(2),
+    )
+    label = np.asarray(out["label"])
+    w = np.asarray(out["bbox_weight"])
+    assert (w[label == 1] == 1.0).all()
+    assert (w[label != 1] == 0.0).all()
+
+
+def test_assign_anchor_targets_decode_to_gt(rng):
+    anchors, gt, valid, im_h, im_w = _setup(rng)
+    out = assign_anchor(
+        jnp.asarray(anchors), jnp.asarray(gt), jnp.asarray(valid),
+        jnp.float32(im_h), jnp.float32(im_w), jax.random.PRNGKey(3),
+    )
+    label = np.asarray(out["label"])
+    tgt = np.asarray(out["bbox_target"])
+    fg = np.where(label == 1)[0]
+    assert len(fg) > 0
+    from mx_rcnn_tpu.ops.boxes import bbox_pred
+    dec = np.asarray(bbox_pred(jnp.asarray(anchors[fg]), jnp.asarray(tgt[fg])))
+    ious = oracles.iou_oracle(dec, gt[valid])
+    assert (ious.max(axis=1) > 0.99).all()
+
+
+def test_assign_anchor_no_gt(rng):
+    anchors, gt, valid, im_h, im_w = _setup(rng, n_gt=0)
+    out = assign_anchor(
+        jnp.asarray(anchors), jnp.asarray(gt), jnp.asarray(np.zeros(MAX_GT, bool)),
+        jnp.float32(im_h), jnp.float32(im_w), jax.random.PRNGKey(4),
+    )
+    label = np.asarray(out["label"])
+    assert (label != 1).all()
+    assert (label == 0).sum() == 256  # all-bg batch
+
+
+def _sample_setup(rng, n_rois=300, n_gt=4, num_classes=21):
+    rois = rng.rand(n_rois, 4).astype(np.float32) * 200
+    rois[:, 2:] = rois[:, :2] + 10 + rng.rand(n_rois, 2) * 100
+    gt = np.zeros((MAX_GT, 4), np.float32)
+    cls = np.zeros(MAX_GT, np.int32)
+    for i in range(n_gt):
+        gt[i] = [20 + 40 * i, 30, 20 + 40 * i + 35, 90]
+        cls[i] = rng.randint(1, num_classes)
+    # append gt to rois (the ProposalTarget contract)
+    rois[:n_gt] = gt[:n_gt]
+    valid = np.ones(n_rois, bool)
+    gt_valid = np.arange(MAX_GT) < n_gt
+    return rois, valid, gt, cls, gt_valid
+
+
+def test_sample_rois_counts_and_labels(rng):
+    rois, valid, gt, cls, gt_valid = _sample_setup(rng)
+    out = sample_rois(
+        jnp.asarray(rois), jnp.asarray(valid), jnp.asarray(gt),
+        jnp.asarray(cls), jnp.asarray(gt_valid), jax.random.PRNGKey(0),
+        num_classes=21, batch_rois=128, fg_fraction=0.25)
+    label = np.asarray(out["label"])
+    assert label.shape == (128,)
+    n_fg = (label > 0).sum()
+    assert 1 <= n_fg <= 32
+    # every fg-sampled roi really has IoU >= 0.5 with a gt of that class
+    srois = np.asarray(out["rois"])
+    for i in np.where(label > 0)[0]:
+        ious = oracles.iou_oracle(srois[i:i + 1], gt[gt_valid])[0]
+        assert ious.max() >= 0.5
+        assert cls[ious.argmax()] == label[i]
+
+
+def test_sample_rois_bbox_layout(rng):
+    rois, valid, gt, cls, gt_valid = _sample_setup(rng)
+    out = sample_rois(
+        jnp.asarray(rois), jnp.asarray(valid), jnp.asarray(gt),
+        jnp.asarray(cls), jnp.asarray(gt_valid), jax.random.PRNGKey(1),
+        num_classes=21)
+    label = np.asarray(out["label"])
+    w = np.asarray(out["bbox_weight"])
+    t = np.asarray(out["bbox_target"])
+    assert w.shape == (128, 84)
+    for i in range(128):
+        l = label[i]
+        if l > 0:
+            want = np.zeros(84)
+            want[4 * l:4 * l + 4] = 1
+            np.testing.assert_array_equal(w[i], want)
+        else:
+            assert (w[i] == 0).all()
+            assert (t[i] == 0).all()
+
+
+def test_sample_rois_targets_decode(rng):
+    rois, valid, gt, cls, gt_valid = _sample_setup(rng)
+    means, stds = (0.0, 0.0, 0.0, 0.0), (0.1, 0.1, 0.2, 0.2)
+    out = sample_rois(
+        jnp.asarray(rois), jnp.asarray(valid), jnp.asarray(gt),
+        jnp.asarray(cls), jnp.asarray(gt_valid), jax.random.PRNGKey(2),
+        num_classes=21, bbox_means=means, bbox_stds=stds)
+    label = np.asarray(out["label"])
+    t = np.asarray(out["bbox_target"])
+    srois = np.asarray(out["rois"])
+    from mx_rcnn_tpu.ops.boxes import bbox_pred
+    for i in np.where(label > 0)[0][:5]:
+        l = label[i]
+        d = t[i, 4 * l:4 * l + 4] * np.asarray(stds) + np.asarray(means)
+        dec = np.asarray(bbox_pred(jnp.asarray(srois[i:i + 1]), jnp.asarray(d[None])))
+        ious = oracles.iou_oracle(dec, gt[gt_valid])[0]
+        assert ious.max() > 0.99
+
+
+def test_sample_rois_no_gt(rng):
+    rois, valid, gt, cls, gt_valid = _sample_setup(rng, n_gt=0)
+    out = sample_rois(
+        jnp.asarray(rois), jnp.asarray(valid), jnp.asarray(gt),
+        jnp.asarray(cls), jnp.asarray(np.zeros(MAX_GT, bool)), jax.random.PRNGKey(3),
+        num_classes=21)
+    label = np.asarray(out["label"])
+    assert (label == 0).all()
+    assert (np.asarray(out["bbox_weight"]) == 0).all()

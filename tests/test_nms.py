@@ -1,0 +1,68 @@
+"""NMS contract tests: jittable padded NMS vs independent greedy oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.nms import nms_padded, nms
+from tests import oracles
+
+
+def _rand_dets(rng, n, span=100.0):
+    boxes = rng.rand(n, 4) * span
+    boxes[:, 2:] = boxes[:, :2] + rng.rand(n, 2) * span * 0.3 + 1
+    scores = rng.rand(n).astype(np.float64)
+    return boxes.astype(np.float32), scores.astype(np.float32)
+
+
+def test_nms_padded_matches_oracle(rng):
+    boxes, scores = _rand_dets(rng, 120)
+    keep_idx, keep_mask = nms_padded(jnp.asarray(boxes), jnp.asarray(scores),
+                                     max_out=120, iou_thresh=0.5)
+    got = list(np.asarray(keep_idx)[np.asarray(keep_mask)])
+    want = oracles.nms_oracle(boxes, scores, 0.5)
+    assert got == want
+
+
+def test_nms_padded_truncates(rng):
+    boxes, scores = _rand_dets(rng, 200)
+    keep_idx, keep_mask = nms_padded(jnp.asarray(boxes), jnp.asarray(scores),
+                                     max_out=5, iou_thresh=0.7)
+    got = list(np.asarray(keep_idx)[np.asarray(keep_mask)])
+    want = oracles.nms_oracle(boxes, scores, 0.7)[:5]
+    assert got == want
+
+
+def test_nms_padded_respects_valid(rng):
+    boxes, scores = _rand_dets(rng, 50)
+    valid = np.ones(50, bool)
+    valid[scores.argmax()] = False
+    keep_idx, keep_mask = nms_padded(jnp.asarray(boxes), jnp.asarray(scores),
+                                     max_out=50, iou_thresh=0.5,
+                                     valid=jnp.asarray(valid))
+    got = set(np.asarray(keep_idx)[np.asarray(keep_mask)].tolist())
+    assert int(scores.argmax()) not in got
+
+
+def test_nms_padded_all_invalid(rng):
+    boxes, scores = _rand_dets(rng, 10)
+    keep_idx, keep_mask = nms_padded(jnp.asarray(boxes), jnp.asarray(scores),
+                                     max_out=10, iou_thresh=0.5,
+                                     valid=jnp.zeros(10, bool))
+    assert not np.asarray(keep_mask).any()
+
+
+def test_host_nms_matches_oracle(rng):
+    boxes, scores = _rand_dets(rng, 80)
+    dets = np.hstack([boxes, scores[:, None]]).astype(np.float32)
+    got = nms(dets, 0.3)
+    want = oracles.nms_oracle(boxes, scores, 0.3)
+    assert got == want
+
+
+def test_nms_identical_boxes():
+    boxes = np.tile(np.array([[10, 10, 50, 50]], np.float32), (5, 1))
+    scores = np.array([0.9, 0.8, 0.7, 0.6, 0.5], np.float32)
+    keep_idx, keep_mask = nms_padded(jnp.asarray(boxes), jnp.asarray(scores),
+                                     max_out=5, iou_thresh=0.5)
+    assert np.asarray(keep_mask).sum() == 1
+    assert int(keep_idx[0]) == 0

@@ -1,0 +1,75 @@
+"""Oracle tests for anchors, box codecs, IoU (SURVEY §4 pyramid level 1)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops import anchors as A
+from mx_rcnn_tpu.ops import boxes as B
+from tests import oracles
+
+
+def test_generate_anchors_matches_oracle():
+    got = A.generate_anchors(16, (0.5, 1.0, 2.0), (8, 16, 32))
+    want = oracles.generate_anchors_oracle(16, (0.5, 1.0, 2.0), (8, 16, 32))
+    assert got.shape == (9, 4)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_generate_anchors_known_values():
+    # the canonical base-16 anchors: first anchor (ratio .5, scale 8)
+    a = A.generate_anchors()
+    # widths/heights follow w*h ≈ (16*scale)^2 with aspect ratio
+    w = a[:, 2] - a[:, 0] + 1
+    h = a[:, 3] - a[:, 1] + 1
+    np.testing.assert_allclose((w * h)[4], (16 * 16) ** 2, rtol=0.1)  # ratio 1 scale 16
+    # centers identical for all
+    cx = a[:, 0] + 0.5 * (w - 1)
+    np.testing.assert_allclose(cx, cx[0])
+
+
+def test_all_anchors_grid():
+    base = A.generate_anchors()
+    grid = A.all_anchors(2, 3, 16, base)
+    assert grid.shape == (2 * 3 * 9, 4)
+    # cell (0,0) anchors = base anchors
+    np.testing.assert_allclose(grid[:9], base)
+    # cell (y=1, x=2) offset by (32, 16)
+    np.testing.assert_allclose(grid[(1 * 3 + 2) * 9], base[0] + np.array([32, 16, 32, 16]))
+
+
+def test_bbox_transform_roundtrip(rng):
+    ex = rng.rand(50, 4) * 100
+    ex[:, 2:] += ex[:, :2] + 5
+    gt = rng.rand(50, 4) * 100
+    gt[:, 2:] += gt[:, :2] + 5
+    deltas = B.bbox_transform(jnp.asarray(ex), jnp.asarray(gt))
+    np.testing.assert_allclose(deltas, oracles.bbox_transform_oracle(ex, gt), rtol=1e-4, atol=1e-4)
+    # decode(encode) == identity
+    rec = B.bbox_pred(jnp.asarray(ex), deltas)
+    np.testing.assert_allclose(rec, gt, rtol=1e-3, atol=1e-2)
+
+
+def test_bbox_pred_multiclass(rng):
+    boxes = rng.rand(20, 4) * 50
+    boxes[:, 2:] += boxes[:, :2] + 3
+    deltas = rng.randn(20, 12) * 0.2
+    got = B.bbox_pred(jnp.asarray(boxes), jnp.asarray(deltas))
+    want = oracles.bbox_pred_oracle(boxes, deltas)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_clip_boxes(rng):
+    boxes = rng.randn(30, 8) * 300
+    got = B.clip_boxes(jnp.asarray(boxes), 200, 300)
+    assert (np.asarray(got[:, 0::4]) <= 299).all() and (np.asarray(got) >= 0).all()
+    assert (np.asarray(got[:, 1::4]) <= 199).all()
+
+
+def test_bbox_overlaps(rng):
+    boxes = rng.rand(40, 4) * 100
+    boxes[:, 2:] += boxes[:, :2] + 1
+    query = rng.rand(7, 4) * 100
+    query[:, 2:] += query[:, :2] + 1
+    got = B.bbox_overlaps(jnp.asarray(boxes), jnp.asarray(query))
+    want = oracles.iou_oracle(boxes, query)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,75 @@
+"""Proposal op and ROIAlign vs oracles."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.anchors import generate_anchors, all_anchors
+from mx_rcnn_tpu.ops.proposal import propose
+from mx_rcnn_tpu.ops.roi_align import roi_align, roi_pool
+from tests import oracles
+
+
+def test_propose_matches_oracle(rng):
+    fh, fw, stride = 6, 8, 16
+    anchors = all_anchors(fh, fw, stride, generate_anchors())
+    n = len(anchors)
+    scores = rng.rand(n).astype(np.float32)
+    deltas = (rng.randn(n, 4) * 0.1).astype(np.float32)
+    im_h, im_w, im_scale = fh * stride, fw * stride, 1.0
+
+    rois, rscores, valid = propose(
+        jnp.asarray(scores), jnp.asarray(deltas), jnp.asarray(anchors),
+        jnp.float32(im_h), jnp.float32(im_w), jnp.float32(im_scale),
+        pre_nms_top_n=200, post_nms_top_n=50, nms_thresh=0.7, min_size=16)
+
+    want_boxes, want_scores = oracles.propose_oracle(
+        scores, deltas, anchors, im_h, im_w, im_scale, 200, 50, 0.7, 16)
+
+    got_boxes = np.asarray(rois)[np.asarray(valid)]
+    got_scores = np.asarray(rscores)[np.asarray(valid)]
+    assert len(got_boxes) == len(want_boxes)
+    np.testing.assert_allclose(got_scores, want_scores, rtol=1e-5)
+    np.testing.assert_allclose(got_boxes, want_boxes, rtol=1e-3, atol=1e-2)
+
+
+def test_propose_min_size_filters_everything():
+    anchors = all_anchors(4, 4, 16, generate_anchors())
+    n = len(anchors)
+    scores = np.ones(n, np.float32)
+    # shrink every box to a point
+    deltas = np.zeros((n, 4), np.float32)
+    deltas[:, 2:] = -10.0  # log-space shrink
+    rois, rscores, valid = propose(
+        jnp.asarray(scores), jnp.asarray(deltas), jnp.asarray(anchors),
+        jnp.float32(64), jnp.float32(64), jnp.float32(1.0),
+        pre_nms_top_n=100, post_nms_top_n=10, nms_thresh=0.7, min_size=16)
+    assert not np.asarray(valid).any()
+
+
+def test_roi_align_matches_oracle(rng):
+    feat = rng.rand(16, 20, 3).astype(np.float32)
+    rois = np.array([
+        [0, 0, 100, 100],
+        [32, 16, 200, 150],
+        [10, 10, 40, 250],
+    ], np.float32)
+    got = roi_align(jnp.asarray(feat), jnp.asarray(rois),
+                    spatial_scale=1 / 16, pooled_size=7, sampling_ratio=2)
+    want = oracles.roi_align_oracle(feat, rois, 1 / 16, 7, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_constant_feature(rng):
+    feat = np.full((10, 10, 1), 3.5, np.float32)
+    rois = np.array([[16, 16, 120, 120]], np.float32)
+    got = np.asarray(roi_align(jnp.asarray(feat), jnp.asarray(rois),
+                               spatial_scale=1 / 16, pooled_size=7))
+    np.testing.assert_allclose(got, 3.5, rtol=1e-5)
+
+
+def test_roi_pool_max_ge_avg(rng):
+    feat = rng.rand(12, 12, 4).astype(np.float32)
+    rois = np.array([[0, 0, 100, 100], [30, 30, 160, 160]], np.float32)
+    avg = np.asarray(roi_align(jnp.asarray(feat), jnp.asarray(rois), spatial_scale=1 / 16))
+    mx = np.asarray(roi_pool(jnp.asarray(feat), jnp.asarray(rois), spatial_scale=1 / 16))
+    assert (mx >= avg - 1e-5).all()
