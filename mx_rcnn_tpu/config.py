@@ -122,13 +122,19 @@ class NetworkConfig:
     FIXED_PARAMS_SHARED: Tuple[str, ...] = ("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta")
     ANCHOR_SCALES: Tuple[int, ...] = (8, 16, 32)
     ANCHOR_RATIOS: Tuple[float, ...] = (0.5, 1.0, 2.0)
-    NUM_ANCHORS: int = 9
     # FPN (capability target per BASELINE.json configs 4-5; not in classic ref)
     HAS_FPN: bool = False
     FPN_FEAT_STRIDES: Tuple[int, ...] = (4, 8, 16, 32, 64)
     FPN_ANCHOR_SCALES: Tuple[int, ...] = (8,)
     FPN_OUT_CHANNELS: int = 256
     HAS_MASK: bool = False
+
+    @property
+    def NUM_ANCHORS(self) -> int:
+        """Anchors per feature cell — derived, never stored, so it cannot
+        drift from the scale/ratio tuples (FPN levels use one scale each)."""
+        scales = self.FPN_ANCHOR_SCALES if self.HAS_FPN else self.ANCHOR_SCALES
+        return len(self.ANCHOR_RATIOS) * len(scales)
 
 
 @dataclass(frozen=True)
@@ -217,7 +223,6 @@ _NETWORK_PRESETS = {
         HAS_FPN=True,
         RCNN_FEAT_STRIDE=4,
         FPN_ANCHOR_SCALES=(8,),
-        NUM_ANCHORS=3,
     ),
     "resnet101_fpn": dict(
         NETWORK="resnet101",
@@ -225,7 +230,6 @@ _NETWORK_PRESETS = {
         HAS_FPN=True,
         RCNN_FEAT_STRIDE=4,
         FPN_ANCHOR_SCALES=(8,),
-        NUM_ANCHORS=3,
     ),
     "resnet101_fpn_mask": dict(
         NETWORK="resnet101",
@@ -234,7 +238,6 @@ _NETWORK_PRESETS = {
         HAS_MASK=True,
         RCNN_FEAT_STRIDE=4,
         FPN_ANCHOR_SCALES=(8,),
-        NUM_ANCHORS=3,
     ),
 }
 

@@ -1,0 +1,5 @@
+"""Model definitions — the reference's ``rcnn/symbol`` layer, flax-native."""
+
+from mx_rcnn_tpu.models.detector import FasterRCNN, build_model, init_params
+from mx_rcnn_tpu.models.backbones import ResNetConv, VGGConv
+from mx_rcnn_tpu.models.heads import RPNHead, RCNNOutput, MaskHead

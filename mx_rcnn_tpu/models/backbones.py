@@ -1,0 +1,183 @@
+"""Backbone networks — flax, NHWC, bfloat16 compute, frozen-BN.
+
+Behavioral contracts from the reference's symbol builders:
+
+* ResNet-50/101 (``rcnn/symbol/symbol_resnet.py``): ``residual_unit``
+  bottlenecks, conv body = stages 1–4 (stride 16 output, 1024 ch), BN with
+  ``use_global_stats=True`` (running stats always, never batch stats) and
+  all gamma/beta frozen via ``fixed_param_prefix``; stage 5 is the RCNN
+  head (see heads.py).
+* VGG-16 (``rcnn/symbol/symbol_vgg.py``): conv1–5 body (stride 16, 512 ch),
+  conv1–2 frozen.
+
+TPU-first: NHWC layout (XLA's native conv layout on TPU), bfloat16 activations
+with float32 params, no BN stat updates (frozen BN folds to a per-channel
+affine — one fused multiply-add, which XLA merges into the adjacent conv).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class FrozenBN(nn.Module):
+    """BatchNorm with ``use_global_stats=True`` semantics.
+
+    Running mean/var are parameters (loaded from pretrained checkpoints,
+    never updated by the optimizer — see train/optim.py's fixed-param mask,
+    which freezes ``gamma``/``beta``/``mean``/``var`` by name).  The whole op
+    is an affine y = x·scale + shift computed from the four params, so XLA
+    fuses it into the preceding conv.
+    """
+
+    epsilon: float = 2e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        gamma = self.param("gamma", nn.initializers.ones, (c,), jnp.float32)
+        beta = self.param("beta", nn.initializers.zeros, (c,), jnp.float32)
+        mean = self.param("mean", nn.initializers.zeros, (c,), jnp.float32)
+        var = self.param("var", nn.initializers.ones, (c,), jnp.float32)
+        scale = gamma / jnp.sqrt(var + self.epsilon)
+        shift = beta - mean * scale
+        return (x * scale.astype(self.dtype) + shift.astype(self.dtype)).astype(self.dtype)
+
+
+class Bottleneck(nn.Module):
+    """ResNet bottleneck (reference ``residual_unit``: BN-before-add variant
+    used by mx-rcnn — conv→bn→relu ×2, conv→bn, projection shortcut, add,
+    relu)."""
+
+    filters: int  # bottleneck (inner) width; output is 4×
+    strides: int = 1
+    project: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        conv = lambda f, k, s, name: nn.Conv(  # noqa: E731
+            f, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2,
+            use_bias=False, dtype=self.dtype, name=name)
+        out = conv(self.filters, 1, 1, "conv1")(x)
+        out = FrozenBN(dtype=self.dtype, name="bn1")(out)
+        out = nn.relu(out)
+        out = conv(self.filters, 3, self.strides, "conv2")(out)
+        out = FrozenBN(dtype=self.dtype, name="bn2")(out)
+        out = nn.relu(out)
+        out = conv(self.filters * 4, 1, 1, "conv3")(out)
+        out = FrozenBN(dtype=self.dtype, name="bn3")(out)
+        if self.project:
+            sc = conv(self.filters * 4, 1, self.strides, "sc_conv")(x)
+            sc = FrozenBN(dtype=self.dtype, name="sc_bn")(sc)
+        else:
+            sc = x
+        return nn.relu(out + sc)
+
+
+class ResNetStage(nn.Module):
+    """One ResNet stage: first unit downsamples/projects, rest are identity."""
+
+    units: int
+    filters: int
+    strides: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = Bottleneck(self.filters, self.strides, project=True,
+                       dtype=self.dtype, name="unit1")(x)
+        for i in range(2, self.units + 1):
+            x = Bottleneck(self.filters, 1, dtype=self.dtype, name=f"unit{i}")(x)
+        return x
+
+
+RESNET_UNITS = {
+    "resnet50": (3, 4, 6, 3),
+    "resnet101": (3, 4, 23, 3),
+    "resnet152": (3, 8, 36, 3),
+}
+
+
+class ResNetConv(nn.Module):
+    """ResNet conv body, stages 1–4 → stride-16 / 1024-channel feature map
+    (reference ``get_resnet_conv``).  If ``all_stages`` is True, also returns
+    the per-stage C2..C5 pyramid (for FPN; C5 at stride 32)."""
+
+    depth: str = "resnet50"
+    dtype: jnp.dtype = jnp.bfloat16
+    all_stages: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        units = RESNET_UNITS[self.depth]
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv1")(x)
+        x = FrozenBN(dtype=self.dtype, name="bn1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        c2 = ResNetStage(units[0], 64, 1, dtype=self.dtype, name="stage1")(x)
+        c3 = ResNetStage(units[1], 128, 2, dtype=self.dtype, name="stage2")(c2)
+        c4 = ResNetStage(units[2], 256, 2, dtype=self.dtype, name="stage3")(c3)
+        if not self.all_stages:
+            return c4  # stride 16, 1024 ch — the classic single-level feature
+        c5 = ResNetStage(units[3], 512, 2, dtype=self.dtype, name="stage4")(c4)
+        return c2, c3, c4, c5
+
+
+class ResNetStage5(nn.Module):
+    """ResNet stage 5 as the RCNN head body (reference: stage 5 units applied
+    to the 14×14 pooled RoI features, stride 2 → 7×7, then global avg pool)."""
+
+    depth: str = "resnet50"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        units = RESNET_UNITS[self.depth][3]
+        x = ResNetStage(units, 512, 2, dtype=self.dtype, name="stage4")(x)
+        return jnp.mean(x, axis=(-3, -2))  # global average pool → (…, 2048)
+
+
+class VGGConv(nn.Module):
+    """VGG-16 conv body (reference ``get_vgg_conv``): 13 convs in 5 blocks,
+    max-pool after blocks 1–4 (not 5) → stride-16 / 512-channel feature."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        cfg: Sequence = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+        for b, (n, f) in enumerate(cfg, start=1):
+            for i in range(1, n + 1):
+                x = nn.Conv(f, (3, 3), padding=[(1, 1), (1, 1)], dtype=self.dtype,
+                            name=f"conv{b}_{i}")(x)
+                x = nn.relu(x)
+            if b < 5:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+class VGGFC(nn.Module):
+    """VGG fc6/fc7 head body on 7×7 pooled RoIs (reference ``get_vgg_rcnn``);
+    dropout omitted at the reference's inference setting (train uses 0.5 —
+    applied when ``deterministic=False``)."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        x = x.reshape(x.shape[:-3] + (-1,))
+        x = nn.Dense(4096, dtype=self.dtype, name="fc6")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=deterministic)(x)
+        x = nn.Dense(4096, dtype=self.dtype, name="fc7")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=deterministic)(x)
+        return x

@@ -1,0 +1,83 @@
+"""Detection losses — explicit-mask equivalents of the reference's loss ops.
+
+The reference uses MXNet loss ops with in-op masking
+(``rcnn/symbol/symbol_resnet.py`` / ``symbol_vgg.py``):
+
+* RPN cls:  ``SoftmaxOutput(use_ignore=True, ignore_label=-1,
+  normalization='valid')`` — cross-entropy over {bg, fg}, ignoring −1
+  labels, normalized by the count of non-ignored anchors.
+* RPN bbox: ``smooth_l1(sigma=3)`` · ``MakeLoss(grad_scale=1/RPN_BATCH_SIZE)``.
+* RCNN cls: ``SoftmaxOutput(normalization='batch')`` over classes.
+* RCNN bbox: ``smooth_l1(sigma=1)`` · ``MakeLoss(grad_scale=1/BATCH_ROIS)``.
+
+Here they are pure-JAX scalar losses with explicit masks (SURVEY §2.2 —
+"pure-JAX losses with explicit masks, no kernel needed").  All reductions
+in float32 regardless of compute dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_ce_ignore(logits: jnp.ndarray, label: jnp.ndarray,
+                      ignore_label: int = -1) -> jnp.ndarray:
+    """Cross-entropy with ignored labels, ``normalization='valid'``.
+
+    logits: (..., K); label: (...) int32, entries == ignore_label excluded
+    from both numerator and denominator.
+    Returns a scalar.
+    """
+    logits = logits.astype(jnp.float32)
+    valid = (label != ignore_label)
+    safe_label = jnp.where(valid, label, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, safe_label[..., None], axis=-1)[..., 0]
+    num = jnp.sum(jnp.where(valid, ce, 0.0))
+    den = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return num / den
+
+
+def softmax_ce_weighted(logits: jnp.ndarray, label: jnp.ndarray,
+                        weight: jnp.ndarray) -> jnp.ndarray:
+    """Cross-entropy normalized by batch size (``normalization='batch'``),
+    with per-row weights (0 drops degenerate rows).  Returns a scalar."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, label[..., None], axis=-1)[..., 0]
+    num = jnp.sum(ce * weight)
+    den = jnp.maximum(jnp.sum(jnp.ones_like(weight)), 1.0)
+    return num / den
+
+
+def smooth_l1(pred: jnp.ndarray, target: jnp.ndarray, weight: jnp.ndarray,
+              sigma: float, norm: float) -> jnp.ndarray:
+    """Masked smooth-L1, summed and divided by ``norm``.
+
+    Matches the reference's ``mx.symbol.smooth_l1(scalar=sigma)`` followed by
+    ``MakeLoss(grad_scale=1/norm)``: elementwise
+      0.5·(σx)²        if |x| < 1/σ²
+      |x| − 0.5/σ²     otherwise
+    with x = weight · (pred − target).
+    """
+    pred = pred.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    x = weight * (pred - target)
+    s2 = sigma * sigma
+    ax = jnp.abs(x)
+    val = jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+    return jnp.sum(val) / norm
+
+
+def mask_bce(logits: jnp.ndarray, target: jnp.ndarray,
+             weight: jnp.ndarray) -> jnp.ndarray:
+    """Per-pixel sigmoid BCE for the mask head (Mask R-CNN), averaged over
+    the pixels of weighted (fg) RoIs only.  logits/target: (R, M, M);
+    weight: (R,) 1 on fg rois."""
+    logits = logits.astype(jnp.float32)
+    per_pix = jnp.maximum(logits, 0) - logits * target + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    per_roi = per_pix.mean(axis=(-1, -2))
+    num = jnp.sum(per_roi * weight)
+    den = jnp.maximum(jnp.sum(weight), 1.0)
+    return num / den

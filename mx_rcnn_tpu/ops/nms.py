@@ -30,13 +30,10 @@ _NEG = -1e10
 
 
 def _iou_one_many(box: jnp.ndarray, boxes: jnp.ndarray) -> jnp.ndarray:
-    """IoU of one (4,) box against (N,4) boxes, legacy +1 convention."""
-    iw = jnp.minimum(box[2], boxes[:, 2]) - jnp.maximum(box[0], boxes[:, 0]) + 1.0
-    ih = jnp.minimum(box[3], boxes[:, 3]) - jnp.maximum(box[1], boxes[:, 1]) + 1.0
-    inter = jnp.maximum(iw, 0.0) * jnp.maximum(ih, 0.0)
-    area1 = (box[2] - box[0] + 1.0) * (box[3] - box[1] + 1.0)
-    areas = (boxes[:, 2] - boxes[:, 0] + 1.0) * (boxes[:, 3] - boxes[:, 1] + 1.0)
-    return inter / jnp.maximum(area1 + areas - inter, 1e-14)
+    """IoU of one (4,) box against (N,4) boxes — single source of truth is
+    boxes.bbox_overlaps (legacy +1 convention lives there only)."""
+    from mx_rcnn_tpu.ops.boxes import bbox_overlaps
+    return bbox_overlaps(box[None, :], boxes)[0]
 
 
 def nms_padded(

@@ -11,8 +11,8 @@ is realized as max over the same static sample grid (documented divergence:
 the reference's exact integer-binned max-pool has data-dependent bin
 extents which are hostile to static shapes; a dense 4-sample-per-bin max is
 the standard TPU substitute and is accuracy-neutral-or-better, like
-ROIAlign itself).  ``kernels/roi_align_pallas.py`` provides the fused
-Pallas kernel behind the same signature.
+ROIAlign itself).  A fused Pallas kernel behind the same signature is
+planned (kernels/ tier); this module is the reference path and test oracle.
 
 Coordinate semantics follow ROIAlign (Mask R-CNN paper): continuous
 coordinates, half-pixel centers, sampling_ratio points per bin axis,

@@ -8,7 +8,9 @@ real chip outside pytest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# unconditional: the shell may export JAX_PLATFORMS=<tpu backend>; unit tests
+# must always run on the virtual 8-device CPU mesh, never the real chip
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,111 @@
+"""Detector assembly tests — train graph, gradients, predict graph.
+
+Small images + small anchor scales so RPN fg/bg anchors exist (the standard
+(8,16,32) scales at stride 16 produce zero inside-image anchors below
+~300 px — itself a behavior inherited from the reference's inside-image
+filter in assign_anchor).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models import build_model, init_params
+
+
+def tiny_cfg(network="resnet50"):
+    cfg = generate_config(
+        network, "PascalVOC",
+        TRAIN__RPN_PRE_NMS_TOP_N=600, TRAIN__RPN_POST_NMS_TOP_N=64,
+        TRAIN__BATCH_ROIS=32,
+        TEST__RPN_PRE_NMS_TOP_N=300, TEST__RPN_POST_NMS_TOP_N=50,
+    )
+    net = dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4))
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((128, 192),), MAX_GT=8)
+    return cfg.replace(network=net, tpu=tpu)
+
+
+def batch(B=2, H=128, W=192, G=8, seed=0):
+    rng = np.random.RandomState(seed)
+    imgs = jnp.asarray(rng.randn(B, H, W, 3), jnp.float32)
+    im_info = jnp.tile(jnp.asarray([[H, W, 1.0]], jnp.float32), (B, 1))
+    gtb = np.zeros((B, G, 4), np.float32)
+    gtv = np.zeros((B, G), bool)
+    gtc = np.zeros((B, G), np.int32)
+    for b in range(B):
+        for g in range(3):
+            x1, y1 = rng.randint(0, W - 40), rng.randint(0, H - 40)
+            gtb[b, g] = (x1, y1, x1 + rng.randint(20, 39), y1 + rng.randint(20, 39))
+            gtc[b, g] = rng.randint(1, 21)
+            gtv[b, g] = True
+    return imgs, im_info, jnp.asarray(gtb), jnp.asarray(gtc), jnp.asarray(gtv)
+
+
+@pytest.mark.parametrize("network", ["resnet50", "vgg16"])
+def test_train_graph_losses_and_grads(network):
+    cfg = tiny_cfg(network)
+    model = build_model(cfg)
+    key = jax.random.PRNGKey(0)
+    params = init_params(model, cfg, key, batch_size=2, image_hw=(128, 192))
+    imgs, im_info, gtb, gtc, gtv = batch()
+
+    def loss_fn(p, k):
+        return model.apply({"params": p}, imgs, im_info, gtb, gtc, gtv, k,
+                           rngs={"dropout": k})
+
+    (tot, aux), grads = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))(params, key)
+    assert np.isfinite(float(tot))
+    # with 20-40 px gt and 32/64 px anchors, RPN must find fg/bg anchors
+    assert float(aux["rpn_cls_loss"]) > 0
+    assert float(aux["rcnn_cls_loss"]) > 0
+    labels = np.asarray(aux["rpn_label"])
+    assert (labels == 1).any() and (labels == 0).any()
+    gn = float(jnp.sqrt(sum(jnp.sum(g * g) for g in jax.tree.leaves(grads))))
+    assert np.isfinite(gn) and gn > 0
+
+
+def test_predict_shapes_and_validity():
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    key = jax.random.PRNGKey(1)
+    params = init_params(model, cfg, key, batch_size=2, image_hw=(128, 192))
+    imgs, im_info, *_ = batch()
+    rois, valid, cls_prob, deltas, scores = jax.jit(
+        lambda p: model.apply({"params": p}, imgs, im_info, method=model.predict)
+    )(params)
+    R = cfg.TEST.RPN_POST_NMS_TOP_N
+    K = cfg.NUM_CLASSES
+    assert rois.shape == (2, R, 4)
+    assert cls_prob.shape == (2, R, K)
+    assert deltas.shape == (2, R, 4 * K)
+    assert np.asarray(valid).any()
+    p = np.asarray(cls_prob)
+    np.testing.assert_allclose(p.sum(-1), 1.0, atol=1e-3)
+    # rois inside the image
+    r = np.asarray(rois)
+    assert (r[..., 0] >= 0).all() and (r[..., 2] <= 192 - 1).all()
+
+
+def test_rpn_and_rcnn_stage_graphs():
+    """Alternate-training stage graphs (rpn_train / rcnn_train) run and
+    produce finite losses."""
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    key = jax.random.PRNGKey(2)
+    params = init_params(model, cfg, key, batch_size=2, image_hw=(128, 192))
+    imgs, im_info, gtb, gtc, gtv = batch()
+
+    tot, aux = jax.jit(lambda p, k: model.apply(
+        {"params": p}, imgs, im_info, gtb, gtv, k, method=model.rpn_train))(params, key)
+    assert np.isfinite(float(tot)) and float(aux["rpn_cls_loss"]) > 0
+
+    rois, _, rvalid = jax.jit(lambda p: model.apply(
+        {"params": p}, imgs, im_info, method=model.predict_rpn))(params)
+    tot2, aux2 = jax.jit(lambda p, k: model.apply(
+        {"params": p}, imgs, im_info, rois, rvalid, gtb, gtc, gtv, k,
+        rngs={"dropout": k}, method=model.rcnn_train))(params, key)
+    assert np.isfinite(float(tot2)) and float(aux2["rcnn_cls_loss"]) > 0
