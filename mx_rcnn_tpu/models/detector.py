@@ -106,7 +106,7 @@ class FasterRCNN(nn.Module):
         anchors = self._anchors_for(fh, fw)
         rpn_cls, rpn_bbox = self.rpn(feat)  # (B, N, 2), (B, N, 4)
 
-        keys = jax.random.split(key, B * 2).reshape(B, 2, 2)
+        keys = jax.random.split(key, (B, 2))  # works for typed and legacy keys
 
         # --- RPN targets (in-graph assign_anchor) ---
         assign = jax.vmap(
@@ -173,7 +173,8 @@ class FasterRCNN(nn.Module):
     # ---- test graph (reference get_*_test) ---------------------------------
 
     def predict(self, images, im_info):
-        """Inference forward: (rois, roi_valid, cls_prob, bbox_deltas).
+        """Inference forward:
+        (rois, roi_valid, cls_prob, bbox_deltas, roi_scores).
 
         rois are in the *scaled* image frame, like the reference's test
         symbol; the eval layer divides by im_scale (tester.py im_detect).
@@ -272,7 +273,12 @@ def build_model(cfg: Config) -> FasterRCNN:
     """Factory — the analogue of the reference's ``get_<net>_train/test``
     symbol selectors (dispatch in train_end2end.py / test.py)."""
     if cfg.network.HAS_FPN:
-        from mx_rcnn_tpu.models.fpn import FPNFasterRCNN
+        try:
+            from mx_rcnn_tpu.models.fpn import FPNFasterRCNN
+        except ImportError as e:
+            raise NotImplementedError(
+                "FPN model variants are not built yet (models/fpn.py pending)"
+            ) from e
         return FPNFasterRCNN(cfg=cfg)
     return FasterRCNN(cfg=cfg)
 

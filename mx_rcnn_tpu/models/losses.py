@@ -46,9 +46,8 @@ def softmax_ce_weighted(logits: jnp.ndarray, label: jnp.ndarray,
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ce = -jnp.take_along_axis(logp, label[..., None], axis=-1)[..., 0]
-    num = jnp.sum(ce * weight)
-    den = jnp.maximum(jnp.sum(jnp.ones_like(weight)), 1.0)
-    return num / den
+    # normalization='batch': divide by the static row count (B·BATCH_ROIS)
+    return jnp.sum(ce * weight) / float(weight.size)
 
 
 def smooth_l1(pred: jnp.ndarray, target: jnp.ndarray, weight: jnp.ndarray,

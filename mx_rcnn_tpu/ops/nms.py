@@ -26,13 +26,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mx_rcnn_tpu.ops.boxes import bbox_overlaps
+
 _NEG = -1e10
 
 
 def _iou_one_many(box: jnp.ndarray, boxes: jnp.ndarray) -> jnp.ndarray:
     """IoU of one (4,) box against (N,4) boxes — single source of truth is
     boxes.bbox_overlaps (legacy +1 convention lives there only)."""
-    from mx_rcnn_tpu.ops.boxes import bbox_overlaps
     return bbox_overlaps(box[None, :], boxes)[0]
 
 

@@ -1,0 +1,17 @@
+"""Device-mesh parallelism layer.
+
+TPU-native replacement for the reference's multi-device machinery
+(``mx.mod.Module`` ctx-group batch split + ``KVStore('device')`` gradient
+aggregation, selected in ``train_end2end.py`` via ``--gpus``/``--kvstore``):
+a ``jax.sharding.Mesh`` with a data axis riding ICI (and a DCN axis for
+multi-slice), batch sharded over data, params replicated, gradient
+all-reduce performed by XLA-inserted collectives.
+"""
+
+from mx_rcnn_tpu.parallel.mesh import (
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+    shard_batch,
+    MeshPlan,
+)

@@ -1,0 +1,91 @@
+"""Mesh construction + sharding plans.
+
+The reference's single parallelism strategy is data parallelism
+(SURVEY §2.3): ``Module`` splits each host batch across ``ctx = [mx.gpu(i)]``
+and ``KVStore('device')`` all-reduces gradients over PCIe/NVLink.  Here the
+same strategy is a named mesh axis:
+
+* ``data`` — batch axis.  Gradients are all-reduced over it by XLA (the
+  collective rides ICI within a slice, DCN across slices when the axis spans
+  slices).
+* ``model`` — reserved model axis (size 1 in the reference configs; the
+  mesh abstraction keeps it open for sharding large backbones / FPN heads —
+  an intentional extension point, not a reference capability).
+
+Everything here is plain `jax.sharding`; no pmap.  A jitted step whose
+inputs carry these shardings gets its collectives inserted by XLA — the
+TPU equivalent of the KVStore push/pull in the reference call stack
+(SURVEY §3.1 "KVStore push/pull gradient reduce").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A mesh plus the shardings the train/eval steps use."""
+
+    mesh: Mesh
+
+    @property
+    def data_axis(self) -> str:
+        return self.mesh.axis_names[0]
+
+    @property
+    def n_data(self) -> int:
+        return self.mesh.shape[self.data_axis]
+
+    def batch(self) -> NamedSharding:
+        """Leading-axis (batch) sharding over the data axis."""
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              data: Optional[int] = None, model: int = 1,
+              axis_names=("data", "model")) -> MeshPlan:
+    """Build a (data, model) mesh from the visible devices.
+
+    ``data`` defaults to ``len(devices) // model``.  On a real pod slice,
+    device order from `jax.devices()` keeps ICI neighbours adjacent, so the
+    data axis rides ICI; a multi-slice job would add a leading DCN axis via
+    `jax.experimental.mesh_utils` — kept out of scope until multi-slice is
+    scripted (the reference's `dist_sync` kvstore analogue, also unscripted
+    there).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if data is None:
+        data = len(devices) // model
+    n = data * model
+    if n > len(devices):
+        raise ValueError(f"mesh {data}x{model} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(data, model)
+    return MeshPlan(mesh=Mesh(arr, axis_names))
+
+
+def batch_sharding(plan: MeshPlan) -> NamedSharding:
+    return plan.batch()
+
+
+def replicated_sharding(plan: MeshPlan) -> NamedSharding:
+    return plan.replicated()
+
+
+def shard_batch(plan: MeshPlan, batch):
+    """Place a host batch (pytree of np arrays, leading axis = batch) onto
+    the mesh, split over the data axis — the analogue of Module's
+    ``work_load_list`` ctx split, minus the host copy per device: a single
+    `device_put` with a sharding does the scatter."""
+    sh = plan.batch()
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)

@@ -1,0 +1,11 @@
+"""Training core — the analogue of the reference's ``rcnn/core``
+(``module.py``/``metric.py``/``callback.py``) plus the driver logic of
+``train_end2end.py: train_net``, rebuilt as one jitted SPMD train step
+over a data mesh.
+"""
+
+from mx_rcnn_tpu.train.optim import make_optimizer, make_lr_schedule, fixed_param_mask
+from mx_rcnn_tpu.train.metric import MetricBank
+from mx_rcnn_tpu.train.callback import Speedometer
+from mx_rcnn_tpu.train.train_step import TrainState, make_train_step, create_train_state
+from mx_rcnn_tpu.train.trainer import fit

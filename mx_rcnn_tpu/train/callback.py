@@ -1,0 +1,43 @@
+"""Training callbacks (reference ``rcnn/core/callback.py``).
+
+``Speedometer`` logs imgs/sec every N batches — the BASELINE.json
+north-star throughput number, emitted per-chip and total.
+"""
+
+from __future__ import annotations
+
+import time
+
+from mx_rcnn_tpu.logger import logger
+
+
+class Speedometer:
+    """imgs/sec logger, reset each epoch (reference mx.callback.Speedometer
+    as wired by train_end2end.py's ``batch_end_callback``)."""
+
+    def __init__(self, batch_size: int, frequent: int = 20, n_chips: int = 1):
+        self.batch_size = batch_size  # global images per step
+        self.frequent = frequent
+        self.n_chips = max(n_chips, 1)
+        self._tic = None
+        self._count = 0
+
+    def reset(self):
+        self._tic = None
+        self._count = 0
+
+    def __call__(self, epoch: int, step: int, metric_str: str = ""):
+        self._count += 1
+        if self._tic is None:
+            self._tic = time.time()
+            self._count = 0
+            return None
+        if self._count % self.frequent == 0:
+            dt = time.time() - self._tic
+            speed = self.frequent * self.batch_size / max(dt, 1e-9)
+            logger.info(
+                "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec (%.2f/chip)\t%s",
+                epoch, step, speed, speed / self.n_chips, metric_str)
+            self._tic = time.time()
+            return speed
+        return None

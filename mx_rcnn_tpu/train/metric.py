@@ -1,0 +1,66 @@
+"""The six reference training metrics (``rcnn/core/metric.py``).
+
+| Reference class    | Here (key)      | Definition                                      |
+|--------------------|-----------------|-------------------------------------------------|
+| RPNAccMetric       | RPNAcc          | argmax accuracy over anchors with label != −1   |
+| RPNLogLossMetric   | RPNLogLoss      | the RPN softmax CE (valid-normalized)           |
+| RPNL1LossMetric    | RPNL1Loss       | the RPN smooth-L1 loss                          |
+| RCNNAccMetric      | RCNNAcc         | argmax accuracy over sampled (weighted) RoIs    |
+| RCNNLogLossMetric  | RCNNLogLoss     | the RCNN softmax CE (batch-normalized)          |
+| RCNNL1LossMetric   | RCNNL1Loss      | the RCNN smooth-L1 loss                         |
+
+The reference computes these on host from executor outputs each batch and
+keeps running means inside ``mx.metric.CompositeEvalMetric``; here the
+per-step scalars are produced inside the jitted step (metric_scalars, one
+transfer of six floats) and ``MetricBank`` keeps the running means.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def metric_scalars(aux: dict) -> dict:
+    """Fold a train-step ``aux`` dict into the six named scalars (device)."""
+    out = {}
+    if "rpn_label" in aux:
+        valid = aux["rpn_label"] != -1
+        correct = (aux["rpn_pred"] == aux["rpn_label"]) & valid
+        out["RPNAcc"] = (jnp.sum(correct.astype(jnp.float32))
+                         / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0))
+        out["RPNLogLoss"] = aux["rpn_cls_loss"]
+        out["RPNL1Loss"] = aux["rpn_bbox_loss"]
+    if "rcnn_label" in aux:
+        w = aux["rcnn_label_weight"]
+        correct = (aux["rcnn_pred"] == aux["rcnn_label"]).astype(jnp.float32) * w
+        out["RCNNAcc"] = jnp.sum(correct) / jnp.maximum(jnp.sum(w), 1.0)
+        out["RCNNLogLoss"] = aux["rcnn_cls_loss"]
+        out["RCNNL1Loss"] = aux["rcnn_bbox_loss"]
+    if "mask_loss" in aux:
+        out["MaskLoss"] = aux["mask_loss"]
+    return out
+
+
+class MetricBank:
+    """Running means over an epoch — the CompositeEvalMetric analogue."""
+
+    def __init__(self):
+        self._sum: dict = {}
+        self._n = 0
+
+    def update(self, scalars: dict):
+        for k, v in scalars.items():
+            self._sum[k] = self._sum.get(k, 0.0) + float(v)
+        self._n += 1
+
+    def reset(self):
+        self._sum.clear()
+        self._n = 0
+
+    def get(self) -> dict:
+        if self._n == 0:
+            return {}
+        return {k: v / self._n for k, v in self._sum.items()}
+
+    def format(self) -> str:
+        return "\t".join(f"{k}={v:.5f}" for k, v in self.get().items())

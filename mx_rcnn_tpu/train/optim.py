@@ -1,0 +1,108 @@
+"""Optimizer — reference ``train_end2end.py`` optimizer block as optax.
+
+Reference contract (SURVEY §3.1):
+  SGD(learning_rate=lr, momentum=0.9, wd=0.0005, clip_gradient=5,
+      lr_scheduler=MultiFactorScheduler(step=lr_steps, factor=0.1),
+      rescale_grad=1/batch)
+plus ``fixed_param_prefix`` freezing applied by MutableModule
+(``rcnn/core/module.py``): params whose name starts with a fixed prefix get
+no updates.  Our losses already divide by batch, so ``rescale_grad`` is
+folded in.
+
+MXNet SGD applies wd as decoupled-from-loss weight decay inside the update
+(grad += wd * weight before momentum); optax ``add_decayed_weights`` before
+``sgd`` reproduces it.  Clip is per-element clipping in MXNet
+(``clip_gradient`` clamps each gradient value to ±5), NOT global-norm —
+mirrored with a custom elementwise clamp.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from mx_rcnn_tpu.config import Config
+
+# FrozenBN statistics live in the param tree (backbones.FrozenBN) but are
+# never optimizer targets, in any config.
+_ALWAYS_FROZEN = ("mean", "var")
+
+
+def fixed_param_mask(params, fixed_prefixes: Sequence[str]):
+    """True = trainable, False = frozen.
+
+    Reference semantics (``rcnn/core/module.py`` fixed_param_prefix): MXNet
+    matches ``name.startswith(prefix)`` on FLAT param names
+    (``conv1_weight``, ``stage1_unit1_conv1_weight`` — so ``conv1`` freezes
+    the stem conv but NOT ``stage2_unit1_conv1``).  Our equivalent flat name
+    is the tree path below the top-level submodule (backbone/rpn/...)
+    joined with ``_``.  Prefixes that are BN leaf names (``gamma``/``beta``)
+    freeze those leaves everywhere — frozen-BN affine; running ``mean``/
+    ``var`` are never optimizer targets in any config.
+    """
+    structural = tuple(p for p in fixed_prefixes if p not in ("gamma", "beta"))
+    leaf_frozen = set(p for p in fixed_prefixes if p in ("gamma", "beta"))
+    leaf_frozen.update(_ALWAYS_FROZEN)
+
+    def frozen(path) -> bool:
+        names = [e.key if hasattr(e, "key") else str(e) for e in path]
+        flat = "_".join(names[1:]) if len(names) > 1 else names[0]
+        if any(flat.startswith(p) for p in structural):
+            return True
+        return names[-1] in leaf_frozen
+
+    return jax.tree_util.tree_map_with_path(lambda p, _: not frozen(p), params)
+
+
+def make_lr_schedule(cfg: Config, steps_per_epoch: int,
+                     begin_epoch: int = 0) -> Callable:
+    """MultiFactorScheduler(step=LR_STEP epochs, factor=LR_FACTOR) with
+    optional linear warmup (reference ``config.TRAIN.WARMUP*``)."""
+    tr = cfg.TRAIN
+    boundaries = {}
+    for e in tr.LR_STEP:
+        s = (e - begin_epoch) * steps_per_epoch
+        if s > 0:
+            boundaries[s] = tr.LR_FACTOR
+    sched = optax.piecewise_constant_schedule(tr.LR, boundaries)
+    if tr.WARMUP and tr.WARMUP_STEP > 0:
+        warm = optax.linear_schedule(tr.WARMUP_LR, tr.LR, tr.WARMUP_STEP)
+        return optax.join_schedules([warm, sched], [tr.WARMUP_STEP])
+    return sched
+
+
+def _clip_elementwise(clip: float) -> optax.GradientTransformation:
+    """MXNet ``clip_gradient``: clamp every gradient element to [−clip, clip]."""
+
+    def update(updates, state, params=None):
+        del params
+        return jax.tree.map(lambda g: jnp.clip(g, -clip, clip), updates), state
+
+    return optax.GradientTransformation(lambda _: optax.EmptyState(), update)
+
+
+def make_optimizer(cfg: Config, steps_per_epoch: int, params,
+                   begin_epoch: int = 0,
+                   fixed_prefixes: Sequence[str] | None = None):
+    """Build the optax transform + the trainable mask.
+
+    Returns (tx, schedule).  Frozen params receive zero updates via
+    ``optax.masked`` — the MutableModule ``fixed_param_prefix`` contract.
+    """
+    tr = cfg.TRAIN
+    if fixed_prefixes is None:
+        fixed_prefixes = cfg.network.FIXED_PARAMS
+    mask = fixed_param_mask(params, fixed_prefixes)
+    schedule = make_lr_schedule(cfg, steps_per_epoch, begin_epoch)
+    inner = optax.chain(
+        _clip_elementwise(tr.CLIP_GRADIENT),
+        optax.add_decayed_weights(tr.WD),
+        optax.sgd(learning_rate=schedule, momentum=tr.MOMENTUM),
+    )
+    labels = jax.tree.map(lambda t: "train" if t else "frozen", mask)
+    tx = optax.multi_transform(
+        {"train": inner, "frozen": optax.set_to_zero()}, labels)
+    return tx, schedule

@@ -1,0 +1,70 @@
+"""``fit`` — the reference's ``Module.fit`` call in ``train_net``
+(train_end2end.py), as an explicit loop over the jitted step.
+
+Responsibilities mirrored: per-epoch data iteration, composite metrics,
+Speedometer batch-end callback, do_checkpoint epoch-end callback, resume.
+The loader yields host batches; ``shard_batch`` scatters them over the
+mesh (the Module ctx split).  Dispatch is async — the host stays one step
+ahead of the device (the reference got this from MXNet's dependency
+engine; here it falls out of jax dispatch).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.parallel.mesh import MeshPlan, shard_batch
+from mx_rcnn_tpu.train.callback import Speedometer
+from mx_rcnn_tpu.train.checkpoint import CheckpointManager
+from mx_rcnn_tpu.train.metric import MetricBank
+from mx_rcnn_tpu.train.train_step import TrainState, create_train_state, make_train_step
+
+
+def fit(cfg: Config, model, params, train_loader,
+        begin_epoch: int = 0, end_epoch: int = 10,
+        plan: Optional[MeshPlan] = None,
+        prefix: Optional[str] = None,
+        graph: str = "end2end",
+        seed: int = 0,
+        frequent: int = 20,
+        fixed_prefixes=None) -> TrainState:
+    """Train ``model`` from ``params`` over ``train_loader`` epochs.
+
+    train_loader: iterable over epochs; each iteration yields dict batches
+    (numpy, leading axis = global batch).  Must expose ``steps_per_epoch``
+    and ``batch_size`` attributes (loader.py contract).
+    """
+    steps_per_epoch = len(train_loader)
+    state, tx = create_train_state(cfg, params, steps_per_epoch,
+                                   begin_epoch=begin_epoch,
+                                   fixed_prefixes=fixed_prefixes)
+    step_fn = make_train_step(model, tx, plan=plan, graph=graph)
+
+    ckpt = CheckpointManager(prefix) if prefix else None
+    n_chips = plan.n_data if plan else 1
+    speedo = Speedometer(train_loader.batch_size, frequent=frequent,
+                         n_chips=n_chips)
+    bank = MetricBank()
+    key = jax.random.PRNGKey(seed)
+
+    for epoch in range(begin_epoch, end_epoch):
+        bank.reset()
+        speedo.reset()
+        for i, batch in enumerate(train_loader):
+            key, sub = jax.random.split(key)
+            if plan is not None:
+                batch = shard_batch(plan, batch)
+            state, metrics = step_fn(state, batch, sub)
+            bank.update(jax.device_get(metrics))
+            speedo(epoch, i, bank.format())
+        logger.info("Epoch[%d] Train-%s", epoch,
+                    bank.format().replace("\t", " Train-"))
+        if ckpt is not None:
+            ckpt.save_epoch(epoch + 1, state.params, cfg,
+                            opt_state=state.opt_state,
+                            step=int(jax.device_get(state.step)))
+    return state

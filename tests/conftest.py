@@ -1,19 +1,31 @@
-"""Test config: force an 8-device virtual CPU platform before jax imports.
+"""Test config: force an 8-device virtual CPU platform.
 
 Mirrors SURVEY.md §4's rebuild test pyramid: all unit/sharding tests run on
-CPU with XLA_FLAGS=--xla_force_host_platform_device_count=8 so the data-
-parallel mesh is exercised without a TPU pod.  Bench (bench.py) runs on the
-real chip outside pytest.
+CPU with xla_force_host_platform_device_count=8 so the data-parallel mesh is
+exercised without a TPU pod.  Bench (bench.py) runs on the real chip outside
+pytest.
+
+NOTE: this environment pre-imports jax at interpreter startup (axon platform
+hook), so env vars alone are too late — the platform must be forced through
+``jax.config`` before the backend initializes (first device query).
 """
 
 import os
 
-# unconditional: the shell may export JAX_PLATFORMS=<tpu backend>; unit tests
-# must always run on the virtual 8-device CPU mesh, never the real chip
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# persistent compile cache: full-model CPU compiles dominate suite runtime
+cache_dir = os.environ.get("JAX_TEST_CACHE", "/tmp/jax_test_cache")
+jax.config.update("jax_compilation_cache_dir", cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+assert jax.devices()[0].platform == "cpu", jax.devices()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,150 @@
+"""Train-layer tests: optimizer mask/schedule, checkpoint fold contract, and
+the sharded train step on the virtual 8-device CPU mesh (SURVEY §4 pyramid
+item 4 — mesh exercised without a pod)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models import build_model, init_params
+from mx_rcnn_tpu.parallel import make_mesh, shard_batch
+from mx_rcnn_tpu.train import (MetricBank, create_train_state, fixed_param_mask,
+                               make_lr_schedule, make_train_step)
+from mx_rcnn_tpu.train.checkpoint import (denormalize_for_save, load_params_npz,
+                                          normalize_for_train, save_params_npz)
+
+
+def tiny_cfg():
+    cfg = generate_config(
+        "resnet50", "PascalVOC",
+        TRAIN__RPN_PRE_NMS_TOP_N=200, TRAIN__RPN_POST_NMS_TOP_N=32,
+        TRAIN__BATCH_ROIS=16,
+    )
+    net = dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4))
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=4)
+    return cfg.replace(network=net, tpu=tpu)
+
+
+def make_batch(B, H=64, W=96, G=4, seed=0):
+    rng = np.random.RandomState(seed)
+    gtb = np.zeros((B, G, 4), np.float32)
+    gtv = np.zeros((B, G), bool)
+    gtc = np.zeros((B, G), np.int32)
+    for b in range(B):
+        for g in range(2):
+            x1, y1 = rng.randint(0, W - 40), rng.randint(0, H - 40)
+            gtb[b, g] = (x1, y1, x1 + rng.randint(20, 39), y1 + rng.randint(20, 39))
+            gtc[b, g] = rng.randint(1, 21)
+            gtv[b, g] = True
+    return dict(
+        images=rng.randn(B, H, W, 3).astype(np.float32),
+        im_info=np.tile(np.asarray([[H, W, 1.0]], np.float32), (B, 1)),
+        gt_boxes=gtb, gt_classes=gtc, gt_valid=gtv,
+    )
+
+
+def test_fixed_param_mask_prefixes():
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    mask = fixed_param_mask(params, cfg.network.FIXED_PARAMS)
+    flat = jax.tree_util.tree_flatten_with_path(mask)[0]
+    by_path = {"/".join(getattr(e, "key", str(e)) for e in p): v for p, v in flat}
+    # conv1 / bn1 / stage1 frozen; all gamma/beta/mean/var frozen everywhere
+    assert not by_path["backbone/conv1/kernel"]
+    assert not by_path["backbone/bn1/gamma"]
+    assert not any(v for k, v in by_path.items() if k.startswith("backbone/stage1/"))
+    assert not any(v for k, v in by_path.items()
+                   if k.rsplit("/", 1)[-1] in ("gamma", "beta", "mean", "var"))
+    # stage2+ convs, rpn, heads trainable
+    assert by_path["backbone/stage2/unit1/conv1/kernel"]
+    assert by_path["rpn/rpn_conv_3x3/kernel"]
+    assert by_path["rcnn_out/bbox_pred/kernel"]
+
+
+def test_lr_schedule_multifactor_and_warmup():
+    cfg = tiny_cfg()
+    tr = dataclasses.replace(cfg.TRAIN, LR=0.01, LR_STEP=(2, 4), LR_FACTOR=0.1)
+    sched = make_lr_schedule(cfg.replace(TRAIN=tr), steps_per_epoch=10)
+    assert np.isclose(float(sched(0)), 0.01)
+    assert np.isclose(float(sched(19)), 0.01)
+    assert np.isclose(float(sched(20)), 1e-3)
+    assert np.isclose(float(sched(40)), 1e-4)
+    tr2 = dataclasses.replace(tr, WARMUP=True, WARMUP_LR=1e-4, WARMUP_STEP=5)
+    sched2 = make_lr_schedule(cfg.replace(TRAIN=tr2), steps_per_epoch=10)
+    assert float(sched2(0)) < 0.001
+    assert np.isclose(float(sched2(5)), 0.01)
+
+
+def test_bbox_fold_roundtrip():
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    saved = denormalize_for_save(params, cfg)
+    # kernel scaled by stds tiled per class
+    k0 = np.asarray(params["rcnn_out"]["bbox_pred"]["kernel"])
+    k1 = np.asarray(saved["rcnn_out"]["bbox_pred"]["kernel"])
+    stds = np.tile(np.asarray(cfg.TRAIN.BBOX_STDS), cfg.NUM_CLASSES)
+    np.testing.assert_allclose(k1, k0 * stds[None, :], rtol=1e-6)
+    # other layers untouched
+    np.testing.assert_array_equal(
+        np.asarray(params["rpn"]["rpn_conv_3x3"]["kernel"]),
+        np.asarray(saved["rpn"]["rpn_conv_3x3"]["kernel"]))
+    back = normalize_for_train(saved, cfg)
+    np.testing.assert_allclose(
+        np.asarray(back["rcnn_out"]["bbox_pred"]["kernel"]), k0, rtol=1e-5)
+
+
+def test_params_npz_roundtrip(tmp_path):
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    path = str(tmp_path / "p.npz")
+    save_params_npz(path, params)
+    back = load_params_npz(path)
+    a = jax.tree_util.tree_flatten_with_path(params)[0]
+    b = jax.tree_util.tree_flatten_with_path(back)[0]
+    assert len(a) == len(b)
+    for (pa, la), (pb, lb) in zip(a, b):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_sharded_train_step_updates_and_freezes():
+    """Data-parallel step over the 8-device CPU mesh: loss finite, trainable
+    params move, frozen params don't, and the six metrics come out."""
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    plan = make_mesh(data=8)
+    state, tx = create_train_state(cfg, params, steps_per_epoch=10)
+    step = make_train_step(model, tx, plan=plan)
+
+    frozen_before = np.asarray(params["backbone"]["conv1"]["kernel"])
+    train_before = np.asarray(params["rpn"]["rpn_conv_3x3"]["kernel"])
+
+    batch = make_batch(B=8)
+    state = jax.device_put(state, plan.replicated())
+    losses = []
+    for i in range(2):
+        sb = shard_batch(plan, batch)
+        state, metrics = step(state, sb, jax.random.PRNGKey(i))
+        m = jax.device_get(metrics)
+        assert np.isfinite(m["total_loss"])
+        losses.append(float(m["total_loss"]))
+    for k in ("RPNAcc", "RPNLogLoss", "RPNL1Loss", "RCNNAcc", "RCNNLogLoss",
+              "RCNNL1Loss"):
+        assert k in m and np.isfinite(m[k])
+
+    new_params = jax.device_get(state.params)
+    np.testing.assert_array_equal(
+        np.asarray(new_params["backbone"]["conv1"]["kernel"]), frozen_before)
+    assert np.abs(np.asarray(new_params["rpn"]["rpn_conv_3x3"]["kernel"])
+                  - train_before).max() > 0
+
+    bank = MetricBank()
+    bank.update(m)
+    assert "RPNAcc" in bank.get()
