@@ -1,0 +1,16 @@
+"""Data layer — the reference's ``rcnn/io`` + ``rcnn/dataset`` +
+``rcnn/core/loader.py`` tier, rebuilt for static XLA shapes:
+
+* datasets (``imdb.py``/``pascal_voc.py``/``coco_dataset.py``) keep the
+  reference's roidb contract;
+* image IO (``image.py``) resizes shortest-side to scale and pads to a
+  static bucket shape (replacing MutableModule executor rebinding);
+* ``loader.py`` assembles padded host batches and double-buffers them to
+  the device — anchor/RoI target assignment happens *in-graph* (ops layer),
+  so the loader ships only images + padded gt.
+"""
+
+from mx_rcnn_tpu.data.image import get_image, transform_image, resize_to_bucket
+from mx_rcnn_tpu.data.imdb import IMDB
+from mx_rcnn_tpu.data.loader import AnchorLoader, TestLoader, ROIIter
+from mx_rcnn_tpu.data.synthetic import SyntheticDataset

@@ -1,0 +1,92 @@
+"""Image IO + geometric transform (reference ``rcnn/io/image.py``).
+
+Contracts kept from the reference:
+
+* ``get_image``: load BGR→RGB, resize so the short side hits SCALE[0] with
+  the long side capped at SCALE[1] (``resize`` keeps aspect; the scale
+  factor is min(target/short, max/long)).
+* pixel-mean subtraction (+ std division; reference PIXEL_STDS=1).
+* stride padding — generalized to *bucket padding*: every image lands in a
+  static (H, W) bucket shape so XLA compiles one program per bucket
+  (replaces ``tensor_vstack`` ragged pad + MutableModule rebinding).
+
+Divergence (documented): the reference feeds CHW float32; we feed NHWC
+(TPU-native conv layout).  Flipping is done on the roidb records
+(imdb.append_flipped_images) exactly like the reference — the image flip
+itself happens here at load time via the ``flipped`` flag.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import cv2
+import numpy as np
+
+
+def bucket_shape(scale: Tuple[int, int], stride: int,
+                 landscape: bool = True) -> Tuple[int, int]:
+    """Static padded (H, W) for a (short, long) scale pair.
+
+    After the reference resize rule the short side is ≤ scale[0] and the
+    long side ≤ scale[1]; rounding both up to the feature stride gives one
+    static shape per orientation.  Orientation split = the reference's
+    aspect-ratio grouping (``rcnn/core/loader.py`` groups horizontal /
+    vertical images per batch), which here also picks the compiled program.
+    """
+    stride = max(int(stride), 1)
+    short, long_ = scale
+    s = int(np.ceil(short / stride) * stride)
+    l = int(np.ceil(long_ / stride) * stride)
+    return (s, l) if landscape else (l, s)
+
+
+def compute_scale(h: int, w: int, scale: Tuple[int, int]) -> float:
+    """Reference resize rule: short side → scale[0], long side ≤ scale[1]."""
+    short, long_ = min(h, w), max(h, w)
+    s = float(scale[0]) / short
+    if s * long_ > scale[1]:
+        s = float(scale[1]) / long_
+    return s
+
+
+def get_image(path: str, flipped: bool = False) -> np.ndarray:
+    """Load an image file → RGB uint8 HWC (reference loads BGR via cv2 and
+    keeps BGR; we standardize on RGB and set PIXEL_MEANS accordingly)."""
+    im = cv2.imread(path, cv2.IMREAD_COLOR)
+    if im is None:
+        raise FileNotFoundError(path)
+    im = cv2.cvtColor(im, cv2.COLOR_BGR2RGB)
+    if flipped:
+        im = im[:, ::-1, :]
+    return im
+
+
+def transform_image(im: np.ndarray, pixel_means: Sequence[float],
+                    pixel_stds: Sequence[float] = (1.0, 1.0, 1.0)) -> np.ndarray:
+    """float32 + normalize; stays HWC (reference ``transform`` also moves to
+    CHW — not here, TPU convs are NHWC)."""
+    out = im.astype(np.float32)
+    out -= np.asarray(pixel_means, np.float32)
+    out /= np.asarray(pixel_stds, np.float32)
+    return out
+
+
+def resize_to_bucket(im: np.ndarray, scale: Tuple[int, int], stride: int):
+    """Resize by the reference rule and zero-pad into the orientation's
+    bucket shape.
+
+    Returns (padded_image (Hb, Wb, 3), im_scale, (eff_h, eff_w)) where
+    eff_h/eff_w are the valid (non-pad) extents — the im_info contract
+    (reference im_info = [round(h·s), round(w·s), s])."""
+    h, w = im.shape[:2]
+    s = compute_scale(h, w, scale)
+    im_r = cv2.resize(im, None, None, fx=s, fy=s, interpolation=cv2.INTER_LINEAR)
+    eh, ew = im_r.shape[:2]
+    hb, wb = bucket_shape(scale, stride, landscape=(w >= h))
+    if eh > hb or ew > wb:  # guard: rounding never exceeds the bucket
+        im_r = im_r[:hb, :wb]
+        eh, ew = im_r.shape[:2]
+    out = np.zeros((hb, wb) + im.shape[2:], np.float32)
+    out[:eh, :ew] = im_r
+    return out, s, (eh, ew)

@@ -1,0 +1,93 @@
+"""Dataset base class (reference ``rcnn/dataset/imdb.py``).
+
+The roidb contract is the reference's, verbatim: a list of per-image dicts
+
+    {image: path, height, width,
+     boxes: (G, 4) float32 [x1,y1,x2,y2],
+     gt_classes: (G,) int32 (0 = background, never present in gt),
+     gt_overlaps: (G, K) float32,
+     max_classes: (G,), max_overlaps: (G,),
+     flipped: bool}
+
+plus ``append_flipped_images`` (x-mirror the boxes, mark flipped — doubles
+the roidb; the image itself is flipped at load time) and a pickle cache.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.logger import logger
+
+
+class IMDB:
+    def __init__(self, name: str, image_set: str, root_path: str,
+                 dataset_path: str):
+        self.name = name + "_" + image_set
+        self.image_set = image_set
+        self.root_path = root_path
+        self.data_path = dataset_path
+        self.classes: List[str] = []
+        self.num_images = 0
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.classes)
+
+    @property
+    def cache_path(self) -> str:
+        p = os.path.join(self.root_path, "cache")
+        os.makedirs(p, exist_ok=True)
+        return p
+
+    # -- to be implemented by subclasses ------------------------------------
+    def gt_roidb(self) -> list:
+        raise NotImplementedError
+
+    def evaluate_detections(self, detections) -> dict:
+        raise NotImplementedError
+
+    # -- shared machinery ----------------------------------------------------
+    def load_cached(self, tag: str, builder):
+        cache_file = os.path.join(self.cache_path, f"{self.name}_{tag}.pkl")
+        if os.path.exists(cache_file):
+            with open(cache_file, "rb") as f:
+                data = pickle.load(f)
+            logger.info("%s %s loaded from %s", self.name, tag, cache_file)
+            return data
+        data = builder()
+        with open(cache_file, "wb") as f:
+            pickle.dump(data, f, pickle.HIGHEST_PROTOCOL)
+        logger.info("%s wrote %s cache to %s", self.name, tag, cache_file)
+        return data
+
+    def append_flipped_images(self, roidb: list) -> list:
+        """Double the roidb with x-flipped records (reference semantics:
+        boxes mirrored on image width; loader flips pixels at read time)."""
+        flipped = []
+        for rec in roidb:
+            boxes = rec["boxes"].copy()
+            w = rec["width"]
+            x1 = boxes[:, 0].copy()
+            x2 = boxes[:, 2].copy()
+            boxes[:, 0] = w - x2 - 1
+            boxes[:, 2] = w - x1 - 1
+            assert (boxes[:, 2] >= boxes[:, 0]).all()
+            new = dict(rec)
+            new["boxes"] = boxes
+            new["flipped"] = True
+            flipped.append(new)
+        logger.info("%s appended %d flipped images", self.name, len(flipped))
+        return list(roidb) + flipped
+
+    @staticmethod
+    def filter_roidb(roidb: list, min_gt: int = 1) -> list:
+        """Drop images with no usable gt (reference train_end2end filters
+        roidb entries whose fg boxes are empty)."""
+        keep = [r for r in roidb if len(r["boxes"]) >= min_gt]
+        logger.info("filtered roidb: %d -> %d images", len(roidb), len(keep))
+        return keep

@@ -1,0 +1,230 @@
+"""Batch loaders (reference ``rcnn/core/loader.py``: ``AnchorLoader``,
+``ROIIter``, ``TestLoader``).
+
+Differences by design (all SURVEY §7 step-4 decisions):
+
+* No ``feat_sym.infer_shape`` / label pre-computation — anchor and RoI
+  targets are assigned *inside the jitted graph*; the loader ships
+  (images, im_info, gt_boxes·scale, gt_classes, gt_valid) only.
+* Static shapes: images land in per-orientation scale buckets, gt is
+  padded to MAX_GT.  Aspect-ratio grouping (the reference's
+  ``aspect_grouping``) both balances batches and selects the compiled
+  program: one batch never mixes bucket shapes.
+* Host→device overlap: a background thread prepares the next batch(es)
+  while the device runs the current step (replaces MXNet's threaded
+  ``PrefetchingIter``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.data.image import get_image, resize_to_bucket, transform_image
+
+
+def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int]) -> dict:
+    """roidb record → one transformed sample (host numpy)."""
+    if "image_array" in rec:  # synthetic dataset ships pixels inline
+        im = rec["image_array"]
+        if rec.get("flipped", False):
+            im = im[:, ::-1, :]
+    else:
+        im = get_image(rec["image"], flipped=rec.get("flipped", False))
+    im = transform_image(im, cfg.network.PIXEL_MEANS, cfg.network.PIXEL_STDS)
+    stride = max(cfg.network.IMAGE_STRIDE, cfg.network.RPN_FEAT_STRIDE)
+    padded, s, (eh, ew) = resize_to_bucket(im, scale, stride)
+
+    g = cfg.tpu.MAX_GT
+    boxes = np.zeros((g, 4), np.float32)
+    classes = np.zeros((g,), np.int32)
+    valid = np.zeros((g,), bool)
+    n = min(len(rec["boxes"]), g)
+    if n:
+        boxes[:n] = rec["boxes"][:n] * s  # gt scaled into the resized frame
+        classes[:n] = rec["gt_classes"][:n]
+        valid[:n] = True
+    return dict(images=padded,
+                im_info=np.asarray([eh, ew, s], np.float32),
+                gt_boxes=boxes, gt_classes=classes, gt_valid=valid)
+
+
+def _stack(samples: List[dict]) -> Dict[str, np.ndarray]:
+    return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+
+
+class _Prefetcher:
+    """Runs a batch-producing generator in a daemon thread with a bounded
+    queue (depth = cfg.tpu.PREFETCH)."""
+
+    def __init__(self, gen, depth: int):
+        self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._err = None
+
+        def run():
+            try:
+                for item in gen:
+                    self._q.put(item)
+            except BaseException as e:  # surfaced on the consumer side
+                self._err = e
+            finally:
+                self._q.put(None)
+
+        self._t = threading.Thread(target=run, daemon=True)
+        self._t.start()
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                if self._err is not None:
+                    raise self._err
+                return
+            yield item
+
+
+class AnchorLoader:
+    """End-to-end / RPN training loader (reference ``AnchorLoader``).
+
+    Iterable over epochs; each pass yields dict batches.  ``batch_size`` is
+    the GLOBAL images-per-step (the trainer shards over the mesh data axis).
+    Incomplete trailing groups are wrapped by re-sampling from the group
+    (reference pads the last batch by wrapping indices).
+    """
+
+    def __init__(self, roidb: list, cfg: Config, batch_size: int,
+                 shuffle: bool = True, seed: int = 0):
+        if not roidb:
+            raise ValueError("empty roidb")
+        self.roidb = roidb
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        # aspect grouping: horizontal (w>=h) vs vertical image index pools
+        self._groups = [
+            [i for i, r in enumerate(roidb) if r["width"] >= r["height"]],
+            [i for i, r in enumerate(roidb) if r["width"] < r["height"]],
+        ]
+        self._len = sum(len(g) // batch_size + (1 if len(g) % batch_size else 0)
+                        for g in self._groups if g)
+
+    def __len__(self) -> int:
+        return self._len
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self._len
+
+    def _epoch_indices(self) -> List[np.ndarray]:
+        batches = []
+        for g in self._groups:
+            if not g:
+                continue
+            idx = np.asarray(g)
+            if self.shuffle:
+                self._rng.shuffle(idx)
+            for i in range(0, len(idx), self.batch_size):
+                chunk = idx[i:i + self.batch_size]
+                if len(chunk) < self.batch_size:  # wrap like the reference
+                    extra = self._rng.choice(idx, self.batch_size - len(chunk))
+                    chunk = np.concatenate([chunk, extra])
+                batches.append(chunk)
+        if self.shuffle:
+            order = self._rng.permutation(len(batches))
+            batches = [batches[i] for i in order]
+        return batches
+
+    def _produce(self) -> Iterator[Dict[str, np.ndarray]]:
+        scale = self.cfg.tpu.SCALES[0]
+        for chunk in self._epoch_indices():
+            yield _stack([_load_record(self.roidb[i], self.cfg, scale)
+                          for i in chunk])
+
+    def __iter__(self):
+        return iter(_Prefetcher(self._produce(), self.cfg.tpu.PREFETCH))
+
+
+class TestLoader:
+    """Eval loader (reference ``TestLoader``): sequential, no shuffle, no gt
+    needed; batch padded with repeats of the last image (mask via
+    ``batch_valid``)."""
+
+    __test__ = False  # not a pytest class
+
+    def __init__(self, roidb: list, cfg: Config, batch_size: int = 1):
+        self.roidb = roidb
+        self.cfg = cfg
+        self.batch_size = batch_size
+
+    def __len__(self) -> int:
+        n = len(self.roidb)
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        def produce():
+            scale = self.cfg.tpu.SCALES[0]
+            n = len(self.roidb)
+            for start in range(0, n, self.batch_size):
+                idx = list(range(start, min(start + self.batch_size, n)))
+                pad = self.batch_size - len(idx)
+                samples = [_load_record(self.roidb[i], self.cfg, scale)
+                           for i in idx]
+                samples += [samples[-1]] * pad
+                batch = _stack(samples)
+                batch["indices"] = np.asarray(idx + [idx[-1]] * pad, np.int32)
+                batch["batch_valid"] = np.asarray([True] * len(idx) + [False] * pad)
+                yield batch
+
+        return iter(_Prefetcher(produce(), self.cfg.tpu.PREFETCH))
+
+
+class ROIIter:
+    """Fast-RCNN training loader over cached proposals (reference
+    ``ROIIter`` — alternate-training steps 3/6).  Each roidb record carries a
+    ``proposals`` (P, 4) array dumped by ``eval.generate_proposals``; they
+    are padded/truncated to ``cfg.TRAIN.RPN_POST_NMS_TOP_N`` rows and
+    sampled in-graph by ``rcnn_train``."""
+
+    def __init__(self, roidb: list, cfg: Config, batch_size: int,
+                 shuffle: bool = True, seed: int = 0):
+        self._inner = AnchorLoader(roidb, cfg, batch_size, shuffle, seed)
+        self.cfg = cfg
+        self.batch_size = batch_size
+
+    def __len__(self) -> int:
+        return len(self._inner)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return len(self._inner)
+
+    def __iter__(self):
+        cfg = self.cfg
+        p_max = cfg.TRAIN.RPN_POST_NMS_TOP_N
+
+        def produce():
+            scale = cfg.tpu.SCALES[0]
+            for chunk in self._inner._epoch_indices():
+                samples = []
+                for i in chunk:
+                    rec = self._inner.roidb[i]
+                    s = _load_record(rec, cfg, scale)
+                    props = np.asarray(rec.get("proposals",
+                                               np.zeros((0, 4))), np.float32)
+                    rois = np.zeros((p_max, 4), np.float32)
+                    rvalid = np.zeros((p_max,), bool)
+                    n = min(len(props), p_max)
+                    if n:
+                        rois[:n] = props[:n] * s["im_info"][2]
+                        rvalid[:n] = True
+                    s["rois"] = rois
+                    s["roi_valid"] = rvalid
+                    samples.append(s)
+                yield _stack(samples)
+
+        return iter(_Prefetcher(produce(), cfg.tpu.PREFETCH))

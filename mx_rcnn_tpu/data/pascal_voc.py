@@ -1,0 +1,145 @@
+"""PASCAL VOC dataset (reference ``rcnn/dataset/pascal_voc.py`` +
+``pascal_voc_eval.py``).
+
+Contracts kept: VOCdevkit directory layout, XML annotation parsing with
+difficult-object filtering, pickle-cached gt_roidb, detection writeout in
+the official per-class file format, and ``voc_eval`` scoring (both the
+VOC07 11-point AP and the later area-under-PR metric).
+"""
+
+from __future__ import annotations
+
+import os
+import xml.etree.ElementTree as ET
+from typing import Dict, List
+
+import numpy as np
+
+from mx_rcnn_tpu.data.imdb import IMDB
+from mx_rcnn_tpu.logger import logger
+
+VOC_CLASSES = (
+    "__background__",
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+)
+
+
+def parse_voc_rec(filename: str) -> List[Dict]:
+    """Parse one VOC XML annotation into object dicts (reference
+    ``pascal_voc_eval.parse_rec``)."""
+    tree = ET.parse(filename)
+    objects = []
+    for obj in tree.findall("object"):
+        bbox = obj.find("bndbox")
+        objects.append({
+            "name": obj.find("name").text,
+            "difficult": int(obj.find("difficult").text)
+            if obj.find("difficult") is not None else 0,
+            # VOC pixels are 1-indexed → 0-indexed here, like the reference
+            "bbox": [int(float(bbox.find("xmin").text)) - 1,
+                     int(float(bbox.find("ymin").text)) - 1,
+                     int(float(bbox.find("xmax").text)) - 1,
+                     int(float(bbox.find("ymax").text)) - 1],
+        })
+    return objects
+
+
+class PascalVOC(IMDB):
+    """``image_set`` is ``<year>_<set>`` or a ``+``-join of several
+    (``2007_trainval+2012_trainval``, reference train_end2end ``--dataset``)."""
+
+    def __init__(self, image_set: str, root_path: str, dataset_path: str):
+        super().__init__("voc", image_set, root_path, dataset_path)
+        self.classes = list(VOC_CLASSES)
+        self._sets = image_set.split("+")
+        self._index: List[tuple] = []  # (year, image_id)
+        for s in self._sets:
+            year, split = s.split("_")
+            for idx in self._load_image_set_index(year, split):
+                self._index.append((year, idx))
+        self.num_images = len(self._index)
+        logger.info("%s: %d images", self.name, self.num_images)
+
+    # -- paths ---------------------------------------------------------------
+    def _devkit(self, year: str) -> str:
+        return os.path.join(self.data_path, f"VOC{year}")
+
+    def _load_image_set_index(self, year: str, split: str) -> List[str]:
+        path = os.path.join(self._devkit(year), "ImageSets", "Main", split + ".txt")
+        with open(path) as f:
+            return [line.strip().split()[0] for line in f if line.strip()]
+
+    def image_path(self, i: int) -> str:
+        year, idx = self._index[i]
+        return os.path.join(self._devkit(year), "JPEGImages", idx + ".jpg")
+
+    def annotation_path(self, i: int) -> str:
+        year, idx = self._index[i]
+        return os.path.join(self._devkit(year), "Annotations", idx + ".xml")
+
+    # -- roidb ---------------------------------------------------------------
+    def gt_roidb(self) -> list:
+        return self.load_cached("gt_roidb", self._build_gt_roidb)
+
+    def _build_gt_roidb(self) -> list:
+        name_to_cls = {n: i for i, n in enumerate(self.classes)}
+        roidb = []
+        for i in range(self.num_images):
+            objs = parse_voc_rec(self.annotation_path(i))
+            # reference keeps non-difficult objects for training
+            objs = [o for o in objs if not o["difficult"]]
+            g = len(objs)
+            boxes = np.zeros((g, 4), np.float32)
+            gt_classes = np.zeros((g,), np.int32)
+            overlaps = np.zeros((g, self.num_classes), np.float32)
+            for j, o in enumerate(objs):
+                boxes[j] = o["bbox"]
+                cls = name_to_cls[o["name"]]
+                gt_classes[j] = cls
+                overlaps[j, cls] = 1.0
+            size = _image_size(self.image_path(i))
+            roidb.append({
+                "image": self.image_path(i),
+                "height": size[0], "width": size[1],
+                "boxes": boxes, "gt_classes": gt_classes,
+                "gt_overlaps": overlaps,
+                "max_classes": overlaps.argmax(axis=1),
+                "max_overlaps": overlaps.max(axis=1) if g else np.zeros((0,)),
+                "flipped": False,
+            })
+        return roidb
+
+    # -- evaluation ----------------------------------------------------------
+    def evaluate_detections(self, detections, use_07_metric: bool = True) -> dict:
+        """detections: list over classes (bg included, index 0 unused) of
+        per-image (N, 5) [x1,y1,x2,y2,score] arrays — the reference
+        ``all_boxes`` layout from pred_eval.  Returns {class: AP, 'mAP': m}."""
+        from mx_rcnn_tpu.eval.voc_eval import voc_eval
+
+        # gt in voc_eval's expected form, one recs dict per image index
+        recs = {}
+        for i in range(self.num_images):
+            recs[i] = parse_voc_rec(self.annotation_path(i))
+
+        aps = {}
+        for k, cls in enumerate(self.classes):
+            if cls == "__background__":
+                continue
+            ap = voc_eval(detections[k], recs, cls, ovthresh=0.5,
+                          use_07_metric=use_07_metric)
+            aps[cls] = ap
+            logger.info("AP for %s = %.4f", cls, ap)
+        aps["mAP"] = float(np.mean([v for v in aps.values()]))
+        logger.info("Mean AP = %.4f", aps["mAP"])
+        return aps
+
+
+def _image_size(path: str):
+    """(height, width) without decoding the full image."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        w, h = im.size
+    return h, w

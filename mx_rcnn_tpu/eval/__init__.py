@@ -1,0 +1,8 @@
+"""Evaluation layer — the reference's ``rcnn/core/tester.py`` +
+``rcnn/dataset/*_eval`` tier: device-batched inference, host post-process
+(per-class NMS, caps), and the VOC/COCO scoring math re-implemented in-repo
+(no pycocotools dependency; SURVEY §7 preamble).
+"""
+
+from mx_rcnn_tpu.eval.voc_eval import voc_eval, voc_ap
+from mx_rcnn_tpu.eval.tester import Predictor, im_detect, pred_eval, generate_proposals

@@ -1,0 +1,196 @@
+"""COCO RLE mask ops (reference: vendored ``rcnn/pycocotools/_mask.pyx`` +
+``maskApi.c``), re-derived in numpy with the same external behavior:
+
+* column-major (Fortran) run-length encoding starting with a 0-run;
+* the COCO compressed string format (LEB128-style with sign-folded deltas);
+* ``rle_iou`` with crowd semantics (crowd gt → det area denominator);
+* polygons rasterized via cv2.fillPoly (the reference uses its own scanline
+  rasterizer in C; cv2's matches on interior pixels).
+
+Off the training hot path (eval only).  ``native_mask.py`` swaps in the C++
+extension for the O(N·M) run-merge loops when built; this module is the
+behavioral oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Union
+
+import cv2
+import numpy as np
+
+
+def encode(mask: np.ndarray) -> Dict:
+    """Binary (H, W) uint8 mask → RLE dict {'size': [H, W], 'counts': list}.
+
+    Column-major scan; counts alternate 0-runs / 1-runs, starting with the
+    count of leading zeros (possibly 0).
+    """
+    h, w = mask.shape
+    flat = np.asfortranarray(mask).reshape(-1, order="F").astype(np.int8)
+    # run boundaries
+    diff = np.nonzero(flat[1:] != flat[:-1])[0]
+    ends = np.concatenate([diff + 1, [flat.size]])
+    lengths = np.diff(np.concatenate([[0], ends]))
+    counts = lengths.tolist()
+    if flat.size and flat[0] == 1:
+        counts = [0] + counts
+    elif flat.size == 0:
+        counts = [0]
+    return {"size": [h, w], "counts": counts}
+
+
+def decode(rle: Dict) -> np.ndarray:
+    """RLE dict → binary (H, W) uint8 mask."""
+    h, w = rle["size"]
+    counts = rle["counts"]
+    if isinstance(counts, (str, bytes)):
+        counts = string_to_counts(counts)
+    flat = np.zeros(h * w, np.uint8)
+    pos = 0
+    val = 0
+    for c in counts:
+        if val:
+            flat[pos:pos + c] = 1
+        pos += c
+        val ^= 1
+    return flat.reshape((h, w), order="F")
+
+
+def area(rle: Dict) -> int:
+    counts = rle["counts"]
+    if isinstance(counts, (str, bytes)):
+        counts = string_to_counts(counts)
+    return int(sum(counts[1::2]))
+
+
+def counts_to_string(counts: Sequence[int]) -> str:
+    """COCO compressed RLE: 6-bit groups, delta-coded from the 3rd count on
+    (maskApi.c ``rleToString``)."""
+    out = []
+    for i, c in enumerate(counts):
+        x = int(c)
+        if i > 2:
+            x -= int(counts[i - 2])
+        more = True
+        while more:
+            c6 = x & 0x1F
+            x >>= 5
+            more = not (x == 0 and not (c6 & 0x10)) and \
+                   not (x == -1 and (c6 & 0x10))
+            if more:
+                c6 |= 0x20
+            out.append(chr(c6 + 48))
+    return "".join(out)
+
+
+def string_to_counts(s: Union[str, bytes]) -> List[int]:
+    """Inverse of counts_to_string (maskApi.c ``rleFrString``)."""
+    if isinstance(s, bytes):
+        s = s.decode("ascii")
+    counts: List[int] = []
+    i = 0
+    while i < len(s):
+        x = 0
+        k = 0
+        more = True
+        while more:
+            c = ord(s[i]) - 48
+            x |= (c & 0x1F) << (5 * k)
+            more = bool(c & 0x20)
+            i += 1
+            if not more and (c & 0x10):
+                x |= -1 << (5 * (k + 1))  # sign extend
+            k += 1
+        if len(counts) > 2:
+            x += counts[-2]
+        counts.append(x)
+    return counts
+
+
+def merge(rles: List[Dict]) -> Dict:
+    """Union of masks (reference ``rleMerge`` with intersect=0) — used to
+    fuse multi-polygon objects into one RLE."""
+    if not rles:
+        return {"size": [0, 0], "counts": [0]}
+    if len(rles) == 1:
+        return rles[0]
+    m = decode(rles[0])
+    for r in rles[1:]:
+        m |= decode(r)
+    return encode(m)
+
+
+def poly_to_rle(polys: List[Sequence[float]], h: int, w: int) -> Dict:
+    """Polygon list ([[x1,y1,x2,y2,...], ...]) → RLE (reference
+    ``frPoly``)."""
+    mask = np.zeros((h, w), np.uint8)
+    pts = [np.asarray(p, np.float64).reshape(-1, 2).round().astype(np.int32)
+           for p in polys if len(p) >= 6]
+    if pts:
+        cv2.fillPoly(mask, pts, 1)
+    return encode(mask)
+
+
+def ann_to_rle(seg, h: int, w: int) -> Dict:
+    """COCO 'segmentation' field (polygons | uncompressed RLE | compressed
+    RLE) → RLE dict (reference ``annToRLE``)."""
+    if isinstance(seg, list):
+        return poly_to_rle(seg, h, w)
+    if isinstance(seg, dict):
+        if isinstance(seg["counts"], (str, bytes)):
+            return {"size": seg["size"], "counts": string_to_counts(seg["counts"])}
+        return seg
+    raise TypeError(f"bad segmentation: {type(seg)}")
+
+
+def _intersect_runs(a_counts, b_counts, n: int) -> int:
+    """|A ∧ B| via run-merge (the maskApi ``rleArea``-style two-pointer walk);
+    O(runs) without decoding."""
+    ia = ib = 0
+    ca = a_counts[0] if a_counts else n
+    cb = b_counts[0] if b_counts else n
+    va = vb = 0
+    pos = 0
+    inter = 0
+    while pos < n:
+        step = min(ca, cb)
+        if va and vb:
+            inter += step
+        ca -= step
+        cb -= step
+        pos += step
+        if ca == 0:
+            ia += 1
+            ca = a_counts[ia] if ia < len(a_counts) else n
+            va ^= 1
+        if cb == 0:
+            ib += 1
+            cb = b_counts[ib] if ib < len(b_counts) else n
+            vb ^= 1
+    return inter
+
+
+def rle_iou(dts: List[Dict], gts: List[Dict], iscrowd: np.ndarray) -> np.ndarray:
+    """(D, G) mask IoU; crowd gt use det area as union (maskApi ``rleIou``)."""
+    D, G = len(dts), len(gts)
+    out = np.zeros((D, G))
+    for di, d in enumerate(dts):
+        n = d["size"][0] * d["size"][1]
+        da = area(d)
+        for gi, g in enumerate(gts):
+            ga = area(g)
+            inter = _intersect_runs(d["counts"], g["counts"], n)
+            union = da if iscrowd[gi] else da + ga - inter
+            out[di, gi] = inter / union if union > 0 else 0.0
+    return out
+
+
+def masks_to_boxes(rle: Dict) -> np.ndarray:
+    """Tight xywh bbox of an RLE (reference ``rleToBbox``)."""
+    m = decode(rle)
+    ys, xs = np.nonzero(m)
+    if ys.size == 0:
+        return np.zeros(4)
+    return np.asarray([xs.min(), ys.min(), xs.max() - xs.min() + 1,
+                       ys.max() - ys.min() + 1], np.float64)

@@ -1,0 +1,151 @@
+"""Inference/eval driver (reference ``rcnn/core/tester.py``).
+
+``Predictor`` binds params to the jitted test graph; ``im_detect`` applies
+the bbox decode on device and maps boxes back to the original image frame;
+``pred_eval`` runs the dataset loop with per-class threshold → NMS →
+max_per_image cap (all host numpy, off the hot path, exactly like the
+reference); ``generate_proposals`` dumps RPN proposals for 4-step alternate
+training.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.data.loader import TestLoader
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.ops.boxes import bbox_pred as decode_boxes, clip_boxes
+from mx_rcnn_tpu.ops.nms import nms
+
+
+class Predictor:
+    """Bound jitted forward (reference ``Predictor`` wraps a bound executor;
+    here the 'binding' is a jit cache keyed on the bucket shape)."""
+
+    def __init__(self, model, params, cfg: Config):
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        self._predict = jax.jit(
+            lambda p, images, im_info: model.apply(
+                {"params": p}, images, im_info, method=model.predict))
+        self._predict_rpn = jax.jit(
+            lambda p, images, im_info: model.apply(
+                {"params": p}, images, im_info, method=model.predict_rpn))
+
+    def predict(self, images, im_info):
+        return self._predict(self.params, images, im_info)
+
+    def predict_rpn(self, images, im_info):
+        return self._predict_rpn(self.params, images, im_info)
+
+
+def im_detect(predictor: Predictor, batch: dict):
+    """Forward one batch → per-image (scores, boxes) in ORIGINAL image
+    coordinates (reference ``im_detect``: bbox_pred + clip_boxes, then
+    divide by im_scale).
+
+    Returns list of (scores (R, K), boxes (R, 4K), valid (R,)) numpy
+    triples, one per valid batch row.
+
+    Contract: ``predictor.params`` must predict RAW deltas — i.e. params
+    from a saved checkpoint (the de-normalize-at-save fold,
+    train/checkpoint.py) or live training params passed through
+    ``denormalize_for_save`` first.
+    """
+    rois, roi_valid, cls_prob, bbox_deltas, _ = predictor.predict(
+        batch["images"], batch["im_info"])
+    rois, roi_valid, cls_prob, bbox_deltas = jax.device_get(
+        (rois, roi_valid, cls_prob, bbox_deltas))
+    im_info = np.asarray(batch["im_info"])
+
+    out = []
+    n = int(np.sum(batch.get("batch_valid", np.ones(len(rois), bool))))
+    for b in range(n):
+        eh, ew, s = im_info[b]
+        boxes = decode_boxes(rois[b], bbox_deltas[b])  # (R, 4K)
+        boxes = clip_boxes(boxes, eh, ew)
+        boxes = np.asarray(boxes) / s                  # original frame
+        out.append((cls_prob[b], boxes, roi_valid[b]))
+    return out
+
+
+def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
+              max_per_image: Optional[int] = None,
+              thresh: Optional[float] = None,
+              vis: bool = False) -> dict:
+    """Dataset eval loop (reference ``pred_eval``): all_boxes[cls][image] =
+    (N, 5) [x1,y1,x2,y2,score]; per-class score threshold + NMS; global
+    per-image cap; then ``imdb.evaluate_detections``."""
+    cfg = predictor.cfg
+    if max_per_image is None:
+        max_per_image = cfg.TEST.MAX_PER_IMAGE
+    if thresh is None:
+        thresh = cfg.TEST.THRESH
+    num_classes = imdb.num_classes
+    num_images = imdb.num_images
+
+    all_boxes: List[List] = [[None for _ in range(num_images)]
+                             for _ in range(num_classes)]
+    t0 = time.time()
+    done = 0
+    for batch in test_loader:
+        dets = im_detect(predictor, batch)
+        indices = batch["indices"]
+        for b, (scores, boxes, valid) in enumerate(dets):
+            i = int(indices[b])
+            v = np.asarray(valid, bool)
+            for k in range(1, num_classes):
+                sel = (scores[:, k] > thresh) & v
+                cls_scores = scores[sel, k]
+                cls_boxes = boxes[sel, 4 * k:4 * (k + 1)]
+                cls_dets = np.hstack([cls_boxes, cls_scores[:, None]]).astype(
+                    np.float32)
+                keep = nms(cls_dets, cfg.TEST.NMS)
+                all_boxes[k][i] = cls_dets[keep]
+            # cap total detections per image (reference max_per_image block)
+            if max_per_image > 0:
+                scores_all = np.concatenate(
+                    [all_boxes[k][i][:, 4] for k in range(1, num_classes)])
+                if len(scores_all) > max_per_image:
+                    th = np.sort(scores_all)[-max_per_image]
+                    for k in range(1, num_classes):
+                        keep = all_boxes[k][i][:, 4] >= th
+                        all_boxes[k][i] = all_boxes[k][i][keep]
+            done += 1
+        if done % 100 < len(dets):
+            logger.info("im_detect: %d/%d  %.3fs/im", done, num_images,
+                        (time.time() - t0) / max(done, 1))
+    return imdb.evaluate_detections(all_boxes)
+
+
+def generate_proposals(predictor: Predictor, test_loader: TestLoader,
+                       imdb, roidb: list,
+                       cache_path: Optional[str] = None) -> list:
+    """RPN-only pass dumping per-image proposals in ORIGINAL coordinates
+    into the roidb (reference ``generate_proposals`` → .pkl for
+    train_alternate steps 2/5)."""
+    for batch in test_loader:
+        rois, scores, valid = jax.device_get(
+            predictor.predict_rpn(batch["images"], batch["im_info"]))
+        im_info = np.asarray(batch["im_info"])
+        indices = batch["indices"]
+        n = int(np.sum(batch["batch_valid"]))
+        for b in range(n):
+            i = int(indices[b])
+            v = np.asarray(valid[b], bool)
+            props = np.asarray(rois[b])[v] / im_info[b, 2]
+            order = np.argsort(-np.asarray(scores[b])[v])
+            roidb[i]["proposals"] = props[order].astype(np.float32)
+    if cache_path:
+        with open(cache_path, "wb") as f:
+            pickle.dump([r.get("proposals") for r in roidb], f,
+                        pickle.HIGHEST_PROTOCOL)
+        logger.info("wrote proposals to %s", cache_path)
+    return roidb

@@ -8,10 +8,4 @@ multi-slice), batch sharded over data, params replicated, gradient
 all-reduce performed by XLA-inserted collectives.
 """
 
-from mx_rcnn_tpu.parallel.mesh import (
-    make_mesh,
-    batch_sharding,
-    replicated_sharding,
-    shard_batch,
-    MeshPlan,
-)
+from mx_rcnn_tpu.parallel.mesh import make_mesh, shard_batch, MeshPlan

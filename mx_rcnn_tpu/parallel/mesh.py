@@ -74,14 +74,6 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return MeshPlan(mesh=Mesh(arr, axis_names))
 
 
-def batch_sharding(plan: MeshPlan) -> NamedSharding:
-    return plan.batch()
-
-
-def replicated_sharding(plan: MeshPlan) -> NamedSharding:
-    return plan.replicated()
-
-
 def shard_batch(plan: MeshPlan, batch):
     """Place a host batch (pytree of np arrays, leading axis = batch) onto
     the mesh, split over the data axis — the analogue of Module's

@@ -91,9 +91,21 @@ class CheckpointManager:
         self._mgr.wait_until_finished()
         logger.info("Saved checkpoint epoch %d -> %s", epoch, self.prefix)
 
-    def load_epoch(self, epoch: int, cfg, for_training: bool = True):
-        """Returns (params, opt_state_or_None, step)."""
-        restored = self._mgr.restore(epoch)
+    def load_epoch(self, epoch: int, cfg, for_training: bool = True,
+                   abstract_payload=None):
+        """Returns (params, opt_state_or_None, step).
+
+        For exact training resume pass ``abstract_payload`` — a pytree
+        skeleton matching what was saved, e.g.
+        ``{"params": params_like, "opt_state": tx.init(params_like),
+        "step": 0}`` — so orbax restores the true optax state classes
+        (target-less restore returns raw dicts optax cannot consume).
+        """
+        if abstract_payload is not None:
+            restored = self._mgr.restore(
+                epoch, args=ocp.args.StandardRestore(abstract_payload))
+        else:
+            restored = self._mgr.restore(epoch)
         params = restored["params"]
         if for_training:
             params = normalize_for_train(params, cfg)

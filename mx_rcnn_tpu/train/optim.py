@@ -62,15 +62,18 @@ def make_lr_schedule(cfg: Config, steps_per_epoch: int,
     """MultiFactorScheduler(step=LR_STEP epochs, factor=LR_FACTOR) with
     optional linear warmup (reference ``config.TRAIN.WARMUP*``)."""
     tr = cfg.TRAIN
+    warmup = tr.WARMUP_STEP if (tr.WARMUP and tr.WARMUP_STEP > 0) else 0
     boundaries = {}
     for e in tr.LR_STEP:
         s = (e - begin_epoch) * steps_per_epoch
         if s > 0:
-            boundaries[s] = tr.LR_FACTOR
+            # join_schedules evaluates the joined schedule at (step - warmup);
+            # shift so drops still land on GLOBAL steps like MultiFactor
+            boundaries[s - warmup] = tr.LR_FACTOR
     sched = optax.piecewise_constant_schedule(tr.LR, boundaries)
-    if tr.WARMUP and tr.WARMUP_STEP > 0:
-        warm = optax.linear_schedule(tr.WARMUP_LR, tr.LR, tr.WARMUP_STEP)
-        return optax.join_schedules([warm, sched], [tr.WARMUP_STEP])
+    if warmup:
+        warm = optax.linear_schedule(tr.WARMUP_LR, tr.LR, warmup)
+        return optax.join_schedules([warm, sched], [warmup])
     return sched
 
 

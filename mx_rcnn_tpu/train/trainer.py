@@ -2,16 +2,17 @@
 (train_end2end.py), as an explicit loop over the jitted step.
 
 Responsibilities mirrored: per-epoch data iteration, composite metrics,
-Speedometer batch-end callback, do_checkpoint epoch-end callback, resume.
-The loader yields host batches; ``shard_batch`` scatters them over the
-mesh (the Module ctx split).  Dispatch is async — the host stays one step
-ahead of the device (the reference got this from MXNet's dependency
-engine; here it falls out of jax dispatch).
+Speedometer batch-end callback, do_checkpoint epoch-end callback, resume
+(the reference's ``--resume`` loads the begin_epoch checkpoint and
+continues).  The loader yields host batches; ``shard_batch`` scatters them
+over the mesh (the Module ctx split).  Dispatch is async — metrics are
+fetched one step late so the host never blocks the device on the current
+step's scalars.
 """
 
 from __future__ import annotations
 
-from typing import Iterable, Optional
+from typing import Optional
 
 import jax
 
@@ -31,20 +32,37 @@ def fit(cfg: Config, model, params, train_loader,
         graph: str = "end2end",
         seed: int = 0,
         frequent: int = 20,
+        resume: bool = False,
         fixed_prefixes=None) -> TrainState:
     """Train ``model`` from ``params`` over ``train_loader`` epochs.
 
-    train_loader: iterable over epochs; each iteration yields dict batches
-    (numpy, leading axis = global batch).  Must expose ``steps_per_epoch``
-    and ``batch_size`` attributes (loader.py contract).
+    train_loader: iterable over epochs yielding dict batches (numpy,
+    leading axis = global batch), exposing ``steps_per_epoch`` and
+    ``batch_size`` (loader.py contract).
+
+    ``resume=True`` (reference ``--resume``) restores params + optimizer
+    state + step from ``prefix`` at ``begin_epoch``.
     """
-    steps_per_epoch = len(train_loader)
+    steps_per_epoch = train_loader.steps_per_epoch
     state, tx = create_train_state(cfg, params, steps_per_epoch,
                                    begin_epoch=begin_epoch,
                                    fixed_prefixes=fixed_prefixes)
-    step_fn = make_train_step(model, tx, plan=plan, graph=graph)
-
     ckpt = CheckpointManager(prefix) if prefix else None
+
+    if resume:
+        if ckpt is None:
+            raise ValueError("resume=True requires a checkpoint prefix")
+        abstract = jax.device_get(
+            {"params": state.params, "opt_state": state.opt_state, "step": 0})
+        r_params, r_opt, r_step = ckpt.load_epoch(
+            begin_epoch, cfg, for_training=True, abstract_payload=abstract)
+        state = TrainState(step=jax.numpy.asarray(r_step, jax.numpy.int32),
+                           params=r_params,
+                           opt_state=r_opt if r_opt is not None else state.opt_state)
+        logger.info("resumed from %s epoch %d (step %d)", prefix, begin_epoch,
+                    r_step)
+
+    step_fn = make_train_step(model, tx, plan=plan, graph=graph)
     n_chips = plan.n_data if plan else 1
     speedo = Speedometer(train_loader.batch_size, frequent=frequent,
                          n_chips=n_chips)
@@ -54,13 +72,18 @@ def fit(cfg: Config, model, params, train_loader,
     for epoch in range(begin_epoch, end_epoch):
         bank.reset()
         speedo.reset()
+        pending = None  # metrics fetched one step late: device stays ahead
         for i, batch in enumerate(train_loader):
             key, sub = jax.random.split(key)
             if plan is not None:
                 batch = shard_batch(plan, batch)
             state, metrics = step_fn(state, batch, sub)
-            bank.update(jax.device_get(metrics))
+            if pending is not None:
+                bank.update(jax.device_get(pending))
+            pending = metrics
             speedo(epoch, i, bank.format())
+        if pending is not None:
+            bank.update(jax.device_get(pending))
         logger.info("Epoch[%d] Train-%s", epoch,
                     bank.format().replace("\t", " Train-"))
         if ckpt is not None:

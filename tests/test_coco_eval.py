@@ -1,0 +1,199 @@
+"""COCO eval + RLE oracle tests (the reference's vendored-pycocotools tier,
+re-derived — these tests pin the behavioral contract)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.eval import mask_rle as M
+from mx_rcnn_tpu.eval.coco_eval import COCOEval, bbox_iou_xywh
+
+
+# --- RLE ---------------------------------------------------------------------
+
+def test_rle_roundtrip_random(rng):
+    for _ in range(10):
+        mask = (rng.rand(23, 17) > 0.5).astype(np.uint8)
+        r = M.encode(mask)
+        np.testing.assert_array_equal(M.decode(r), mask)
+        assert M.area(r) == int(mask.sum())
+
+
+def test_rle_string_roundtrip(rng):
+    mask = (rng.rand(40, 30) > 0.7).astype(np.uint8)
+    counts = M.encode(mask)["counts"]
+    s = M.counts_to_string(counts)
+    back = M.string_to_counts(s)
+    assert back == counts
+
+
+def test_rle_empty_and_full():
+    z = np.zeros((5, 4), np.uint8)
+    o = np.ones((5, 4), np.uint8)
+    assert M.area(M.encode(z)) == 0
+    assert M.area(M.encode(o)) == 20
+    np.testing.assert_array_equal(M.decode(M.encode(z)), z)
+    np.testing.assert_array_equal(M.decode(M.encode(o)), o)
+
+
+def test_rle_iou_matches_dense(rng):
+    masks = [(rng.rand(20, 20) > 0.6).astype(np.uint8) for _ in range(3)]
+    rles = [M.encode(m) for m in masks]
+    iou = M.rle_iou(rles[:2], rles[1:], np.zeros(2, bool))
+    for i in range(2):
+        for j in range(2):
+            a, b = masks[i], masks[1 + j]
+            inter = np.logical_and(a, b).sum()
+            union = np.logical_or(a, b).sum()
+            expect = inter / union if union else 0.0
+            np.testing.assert_allclose(iou[i, j], expect, rtol=1e-12)
+
+
+def test_poly_to_rle_rect():
+    # axis-aligned rectangle polygon -> area ≈ w*h
+    r = M.poly_to_rle([[2, 3, 12, 3, 12, 9, 2, 9]], 20, 20)
+    m = M.decode(r)
+    assert m[4, 5] == 1 and m[3, 2] == 1
+    assert m[0, 0] == 0
+    assert 60 <= M.area(r) <= 88  # 10x6 .. 11x7 depending on edge rule
+
+
+def test_merge_union():
+    a = np.zeros((6, 6), np.uint8); a[:3] = 1
+    b = np.zeros((6, 6), np.uint8); b[:, :2] = 1
+    merged = M.decode(M.merge([M.encode(a), M.encode(b)]))
+    np.testing.assert_array_equal(merged, np.logical_or(a, b).astype(np.uint8))
+
+
+# --- bbox IoU (xywh, no +1) --------------------------------------------------
+
+def test_bbox_iou_xywh_basic():
+    dt = np.array([[0, 0, 10, 10]], np.float64)
+    gt = np.array([[0, 0, 10, 10], [5, 5, 10, 10]], np.float64)
+    iou = bbox_iou_xywh(dt, gt, np.zeros(2, bool))
+    assert np.isclose(iou[0, 0], 1.0)
+    assert np.isclose(iou[0, 1], 25.0 / 175.0)
+    # crowd: union = det area
+    iou_c = bbox_iou_xywh(dt, gt, np.ones(2, bool))
+    assert np.isclose(iou_c[0, 1], 25.0 / 100.0)
+
+
+# --- COCOEval protocol -------------------------------------------------------
+
+@pytest.fixture
+def tiny_ann(tmp_path):
+    """2 images, 2 categories, 3 gt (one small, one medium, one large-ish)."""
+    ann = {
+        "images": [{"id": 1, "file_name": "a.jpg", "height": 200, "width": 200},
+                   {"id": 2, "file_name": "b.jpg", "height": 200, "width": 200}],
+        "categories": [{"id": 1, "name": "cat"}, {"id": 2, "name": "dog"}],
+        "annotations": [
+            {"id": 1, "image_id": 1, "category_id": 1,
+             "bbox": [10, 10, 20, 20], "area": 400, "iscrowd": 0},
+            {"id": 2, "image_id": 1, "category_id": 2,
+             "bbox": [50, 50, 60, 60], "area": 3600, "iscrowd": 0},
+            {"id": 3, "image_id": 2, "category_id": 1,
+             "bbox": [0, 0, 100, 100], "area": 10000, "iscrowd": 0},
+        ],
+    }
+    p = tmp_path / "ann.json"
+    p.write_text(json.dumps(ann))
+    return str(p)
+
+
+def _det(img, cat, bbox, score):
+    return {"image_id": img, "category_id": cat, "bbox": bbox, "score": score}
+
+
+def test_cocoeval_perfect(tiny_ann):
+    results = [
+        _det(1, 1, [10, 10, 20, 20], 0.9),
+        _det(1, 2, [50, 50, 60, 60], 0.8),
+        _det(2, 1, [0, 0, 100, 100], 0.95),
+    ]
+    stats = COCOEval(tiny_ann, results).evaluate()
+    assert np.isclose(stats["AP"], 1.0)
+    assert np.isclose(stats["AP50"], 1.0)
+    assert np.isclose(stats["AR100"], 1.0)
+
+
+def test_cocoeval_miss_and_fp(tiny_ann):
+    # only one of two cat-1 gt found, plus one pure FP for cat 2
+    results = [
+        _det(1, 1, [10, 10, 20, 20], 0.9),
+        _det(1, 2, [150, 150, 20, 20], 0.99),   # FP ranked above the TP
+        _det(1, 2, [50, 50, 60, 60], 0.8),
+    ]
+    stats = COCOEval(tiny_ann, results).evaluate()
+    assert 0.0 < stats["AP"] < 1.0
+    # cat1: recall 0.5 with precision 1 -> AP ~0.5; cat2: TP at rank 2 ->
+    # precision 0.5 at recall 1 -> AP ~0.5 (101-pt interp)
+    assert 0.4 < stats["AP50"] < 0.6
+
+
+def test_cocoeval_loose_box_only_counts_at_low_iou(tiny_ann):
+    # IoU vs gt [10,10,20,20] of det [12,12,20,20]: inter 18*18=324,
+    # union 400+400-324=476 -> 0.68: TP at thresholds .5-.65, FP above
+    results = [
+        _det(1, 1, [12, 12, 20, 20], 0.9),
+        _det(1, 2, [50, 50, 60, 60], 0.8),
+        _det(2, 1, [0, 0, 100, 100], 0.95),
+    ]
+    stats = COCOEval(tiny_ann, results).evaluate()
+    assert np.isclose(stats["AP50"], 1.0)
+    assert stats["AP75"] < 1.0
+    assert 0.5 < stats["AP"] < 1.0
+
+
+def test_cocoeval_crowd_not_counted(tmp_path):
+    ann = {
+        "images": [{"id": 1, "file_name": "a.jpg", "height": 100, "width": 100}],
+        "categories": [{"id": 1, "name": "cat"}],
+        "annotations": [
+            {"id": 1, "image_id": 1, "category_id": 1,
+             "bbox": [0, 0, 50, 50], "area": 2500, "iscrowd": 1},
+            {"id": 2, "image_id": 1, "category_id": 1,
+             "bbox": [60, 60, 20, 20], "area": 400, "iscrowd": 0},
+        ],
+    }
+    p = tmp_path / "ann.json"
+    p.write_text(json.dumps(ann))
+    # det inside the crowd region: ignored (matched to crowd), not FP;
+    # det on the real gt: TP -> AP 1
+    results = [_det(1, 1, [10, 10, 30, 30], 0.9),
+               _det(1, 1, [60, 60, 20, 20], 0.8)]
+    stats = COCOEval(str(p), results).evaluate()
+    assert np.isclose(stats["AP"], 1.0)
+
+
+def test_cocoeval_area_breakdown(tiny_ann):
+    results = [
+        _det(1, 1, [10, 10, 20, 20], 0.9),     # small (400 < 32^2)
+        _det(1, 2, [50, 50, 60, 60], 0.8),     # medium
+        _det(2, 1, [0, 0, 100, 100], 0.95),    # large
+    ]
+    stats = COCOEval(tiny_ann, results).evaluate()
+    assert np.isclose(stats["APs"], 1.0)
+    assert np.isclose(stats["APm"], 1.0)
+    assert np.isclose(stats["APl"], 1.0)
+
+
+def test_cocoeval_segm_mode(tmp_path):
+    rle1 = M.encode(np.pad(np.ones((20, 20), np.uint8), ((10, 70), (10, 70))))
+    ann = {
+        "images": [{"id": 1, "file_name": "a.jpg", "height": 100, "width": 100}],
+        "categories": [{"id": 1, "name": "cat"}],
+        "annotations": [
+            {"id": 1, "image_id": 1, "category_id": 1,
+             "bbox": [10, 10, 20, 20], "area": 400, "iscrowd": 0,
+             "segmentation": {"size": [100, 100],
+                              "counts": M.counts_to_string(rle1["counts"])}},
+        ],
+    }
+    p = tmp_path / "ann.json"
+    p.write_text(json.dumps(ann))
+    results = [{"image_id": 1, "category_id": 1, "score": 0.9, "area": 400,
+                "segmentation": rle1}]
+    stats = COCOEval(str(p), results, iou_type="segm").evaluate()
+    assert np.isclose(stats["AP"], 1.0)

@@ -1,0 +1,207 @@
+"""Data-layer + VOC-eval tests: image bucketing, imdb contract, loaders
+over the synthetic dataset, voc_eval oracle cases, COCO json roidb."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import SyntheticDataset
+from mx_rcnn_tpu.data.image import bucket_shape, compute_scale, resize_to_bucket
+from mx_rcnn_tpu.data.loader import AnchorLoader, ROIIter, TestLoader
+from mx_rcnn_tpu.eval.voc_eval import voc_ap, voc_eval
+
+
+def small_cfg(**kw):
+    cfg = generate_config("resnet50", "PascalVOC", **kw)
+    import dataclasses
+    return cfg.replace(tpu=dataclasses.replace(cfg.tpu, SCALES=((128, 256),),
+                                               MAX_GT=8))
+
+
+# --- image geometry ---------------------------------------------------------
+
+def test_compute_scale_reference_rule():
+    # short side to 600 unless long side would exceed 1000
+    assert np.isclose(compute_scale(480, 640, (600, 1000)), 600 / 480)
+    # elongated: long side caps
+    assert np.isclose(compute_scale(300, 900, (600, 1000)), 1000 / 900)
+
+
+def test_bucket_shape_orientation_and_stride():
+    assert bucket_shape((600, 1000), 32, landscape=True) == (608, 1024)
+    assert bucket_shape((600, 1000), 32, landscape=False) == (1024, 608)
+    assert bucket_shape((600, 1000), 16, landscape=True) == (608, 1008)
+
+
+def test_resize_to_bucket_pads_and_reports_effective():
+    im = np.ones((480, 640, 3), np.float32)
+    out, s, (eh, ew) = resize_to_bucket(im, (128, 256), 32)
+    assert out.shape == (128, 256, 3)
+    assert np.isclose(s, 128 / 480)
+    assert eh == 128 and ew == int(round(640 * s))
+    # padding is zero, content is nonzero
+    assert out[:eh, :ew].min() > 0
+    assert np.all(out[:, ew:] == 0)
+
+
+# --- synthetic dataset + loaders -------------------------------------------
+
+def test_synthetic_roidb_contract_and_flip():
+    ds = SyntheticDataset(num_images=6, height=120, width=160)
+    roidb = ds.gt_roidb()
+    assert len(roidb) == 6
+    r = roidb[0]
+    for k in ("image", "height", "width", "boxes", "gt_classes",
+              "gt_overlaps", "max_classes", "max_overlaps", "flipped"):
+        assert k in r
+    flipped = ds.append_flipped_images(roidb)
+    assert len(flipped) == 12
+    f = flipped[6]
+    assert f["flipped"]
+    # x-mirror: x1' = W - x2 - 1
+    np.testing.assert_allclose(f["boxes"][:, 0],
+                               r["width"] - roidb[0]["boxes"][:, 2] - 1)
+    assert (f["boxes"][:, 2] >= f["boxes"][:, 0]).all()
+
+
+def test_anchor_loader_batches():
+    cfg = small_cfg()
+    ds = SyntheticDataset(num_images=10, height=120, width=160)
+    roidb = ds.gt_roidb()
+    loader = AnchorLoader(roidb, cfg, batch_size=4, shuffle=True, seed=0)
+    assert len(loader) == 3  # ceil(10/4) with wrap
+    batches = list(loader)
+    assert len(batches) == 3
+    b = batches[0]
+    assert b["images"].shape == (4, 128, 256, 3)
+    assert b["im_info"].shape == (4, 3)
+    assert b["gt_boxes"].shape == (4, 8, 4)
+    assert b["gt_valid"].any()
+    # gt scaled into the resized frame and inside effective extent
+    for i in range(4):
+        eh, ew, s = b["im_info"][i]
+        gb = b["gt_boxes"][i][b["gt_valid"][i]]
+        assert (gb[:, 2] <= ew - 1 + 1e-3).all()
+        assert (gb[:, 3] <= eh - 1 + 1e-3).all()
+
+
+def test_test_loader_padding_and_indices():
+    cfg = small_cfg()
+    ds = SyntheticDataset(num_images=5, height=120, width=160)
+    loader = TestLoader(ds.gt_roidb(), cfg, batch_size=2)
+    batches = list(loader)
+    assert len(batches) == 3
+    last = batches[-1]
+    assert last["batch_valid"].tolist() == [True, False]
+    assert last["indices"].tolist() == [4, 4]
+
+
+def test_roi_iter_ships_proposals():
+    cfg = small_cfg()
+    ds = SyntheticDataset(num_images=4, height=120, width=160)
+    roidb = ds.gt_roidb()
+    for r in roidb:
+        r["proposals"] = r["boxes"].copy()  # perfect proposals
+    loader = ROIIter(roidb, cfg, batch_size=2, shuffle=False)
+    b = next(iter(loader))
+    P = cfg.TRAIN.RPN_POST_NMS_TOP_N
+    assert b["rois"].shape == (2, P, 4)
+    assert b["roi_valid"].sum() > 0
+
+
+# --- voc_eval oracles -------------------------------------------------------
+
+def test_voc_ap_known_curves():
+    # perfect detector: P=1 at all recalls
+    rec = np.array([0.5, 1.0])
+    prec = np.array([1.0, 1.0])
+    assert np.isclose(voc_ap(rec, prec, use_07_metric=False), 1.0)
+    assert np.isclose(voc_ap(rec, prec, use_07_metric=True), 1.0)
+
+
+def _recs_one_gt():
+    return {0: [{"name": "car", "difficult": 0, "bbox": [10, 10, 50, 50]}]}
+
+
+def test_voc_eval_perfect_and_miss():
+    # one gt, one perfect det
+    dets = [np.array([[10, 10, 50, 50, 0.9]], np.float32)]
+    assert np.isclose(voc_eval(dets, _recs_one_gt(), "car"), 1.0)
+    # detection elsewhere -> AP 0
+    dets = [np.array([[200, 200, 240, 240, 0.9]], np.float32)]
+    assert voc_eval(dets, _recs_one_gt(), "car") == 0.0
+
+
+def test_voc_eval_duplicate_is_fp():
+    # two dets on the same gt: second is a duplicate FP -> precision drops
+    dets = [np.array([[10, 10, 50, 50, 0.9],
+                      [11, 11, 51, 51, 0.8]], np.float32)]
+    ap = voc_eval(dets, _recs_one_gt(), "car", use_07_metric=False)
+    assert np.isclose(ap, 1.0)  # recall 1 reached at rank 1; dup after
+    # reversed scores: dup ranked first consumes nothing (same gt), still
+    # recall 1 at rank 2 but precision 0.5 there
+    dets = [np.array([[11, 11, 51, 51, 0.95],
+                      [10, 10, 50, 50, 0.9]], np.float32)]
+    ap2 = voc_eval(dets, _recs_one_gt(), "car", use_07_metric=False)
+    assert np.isclose(ap2, 1.0)
+
+
+def test_voc_eval_difficult_excluded():
+    recs = {0: [{"name": "car", "difficult": 1, "bbox": [10, 10, 50, 50]},
+                {"name": "car", "difficult": 0, "bbox": [100, 100, 150, 150]}]}
+    # det on the difficult gt: neither TP nor FP; det on normal gt: TP
+    dets = [np.array([[10, 10, 50, 50, 0.9],
+                      [100, 100, 150, 150, 0.8]], np.float32)]
+    assert np.isclose(voc_eval(dets, recs, "car"), 1.0)
+
+
+# --- COCO dataset from a fake json -----------------------------------------
+
+@pytest.fixture
+def fake_coco(tmp_path):
+    root = tmp_path / "coco"
+    (root / "annotations").mkdir(parents=True)
+    (root / "val2017").mkdir()
+    ann = {
+        "images": [{"id": 7, "file_name": "a.jpg", "height": 100, "width": 120},
+                   {"id": 3, "file_name": "b.jpg", "height": 80, "width": 90}],
+        "categories": [{"id": 18, "name": "dog"}, {"id": 1, "name": "person"}],
+        "annotations": [
+            {"id": 1, "image_id": 7, "category_id": 18,
+             "bbox": [10, 10, 30, 40], "area": 1200, "iscrowd": 0},
+            {"id": 2, "image_id": 7, "category_id": 1,
+             "bbox": [50, 5, 20, 20], "area": 400, "iscrowd": 0},
+            {"id": 3, "image_id": 3, "category_id": 18,
+             "bbox": [0, 0, 50, 50], "area": 2500, "iscrowd": 1},
+        ],
+    }
+    with open(root / "annotations" / "instances_val2017.json", "w") as f:
+        json.dump(ann, f)
+    return str(root)
+
+
+def test_coco_dataset_roidb(fake_coco):
+    from mx_rcnn_tpu.data.coco_dataset import COCODataset
+
+    ds = COCODataset("val2017", fake_coco, fake_coco)
+    assert ds.num_images == 2
+    assert ds.classes == ["__background__", "person", "dog"]
+    roidb = ds._build_gt_roidb()
+    # images sorted by id: index 0 is id 3 (crowd-only -> no boxes)
+    assert len(roidb[0]["boxes"]) == 0
+    assert len(roidb[1]["boxes"]) == 2
+    # xywh -> xyxy
+    np.testing.assert_allclose(roidb[1]["boxes"][0], [10, 10, 39, 49])
+    assert roidb[1]["gt_classes"].tolist() == [2, 1]
+
+    dets = [None,
+            [np.zeros((0, 5)), np.array([[50, 5, 69, 24, 0.7]])],
+            [np.zeros((0, 5)), np.array([[10, 10, 39, 49, 0.9]])]]
+    res = ds.detections_to_coco(dets)
+    assert len(res) == 2
+    by_cat = {r["category_id"]: r for r in res}
+    assert by_cat[18]["image_id"] == 7
+    np.testing.assert_allclose(by_cat[18]["bbox"], [10, 10, 30, 40])

@@ -77,6 +77,10 @@ def test_lr_schedule_multifactor_and_warmup():
     sched2 = make_lr_schedule(cfg.replace(TRAIN=tr2), steps_per_epoch=10)
     assert float(sched2(0)) < 0.001
     assert np.isclose(float(sched2(5)), 0.01)
+    # LR_STEP drops stay on GLOBAL steps even with warmup in front
+    assert np.isclose(float(sched2(19)), 0.01)
+    assert np.isclose(float(sched2(20)), 1e-3)
+    assert np.isclose(float(sched2(40)), 1e-4)
 
 
 def test_bbox_fold_roundtrip():
@@ -111,6 +115,34 @@ def test_params_npz_roundtrip(tmp_path):
     for (pa, la), (pb, lb) in zip(a, b):
         assert pa == pb
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_checkpoint_save_resume_roundtrip(tmp_path):
+    """save_epoch → load_epoch(abstract) returns an opt_state optax can
+    actually consume (true state classes, not raw dicts)."""
+    import jax.numpy as jnp
+    from mx_rcnn_tpu.train.checkpoint import CheckpointManager
+
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    state, tx = create_train_state(cfg, params, steps_per_epoch=10)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save_epoch(1, state.params, cfg, opt_state=state.opt_state, step=7)
+
+    abstract = jax.device_get(
+        {"params": state.params, "opt_state": state.opt_state, "step": 0})
+    r_params, r_opt, r_step = mgr.load_epoch(1, cfg, for_training=True,
+                                             abstract_payload=abstract)
+    assert r_step == 7
+    np.testing.assert_allclose(
+        np.asarray(r_params["rcnn_out"]["bbox_pred"]["kernel"]),
+        np.asarray(state.params["rcnn_out"]["bbox_pred"]["kernel"]), rtol=1e-5)
+    # restored opt_state must be consumable by tx.update
+    grads = jax.tree.map(jnp.zeros_like, r_params)
+    updates, _ = tx.update(grads, r_opt, r_params)
+    assert jax.tree_util.tree_structure(updates) == \
+        jax.tree_util.tree_structure(r_params)
 
 
 def test_sharded_train_step_updates_and_freezes():
