@@ -59,32 +59,58 @@ def _stack(samples: List[dict]) -> Dict[str, np.ndarray]:
 
 class _Prefetcher:
     """Runs a batch-producing generator in a daemon thread with a bounded
-    queue (depth = cfg.tpu.PREFETCH)."""
+    queue (depth = cfg.tpu.PREFETCH).  Closing (or GC of) the iterator stops
+    the producer — an abandoned consumer must not leave a thread parked on a
+    full queue pinning batches."""
 
     def __init__(self, gen, depth: int):
         self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._err = None
+        self._stop = threading.Event()
 
         def run():
             try:
                 for item in gen:
-                    self._q.put(item)
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
             except BaseException as e:  # surfaced on the consumer side
                 self._err = e
             finally:
-                self._q.put(None)
+                while True:  # sentinel must land even on a full queue
+                    try:
+                        self._q.put(None, timeout=0.2)
+                        break
+                    except queue.Full:
+                        if self._stop.is_set():
+                            break
+                        continue
 
         self._t = threading.Thread(target=run, daemon=True)
         self._t.start()
 
+    def close(self):
+        self._stop.set()
+
+    def __del__(self):
+        self._stop.set()
+
     def __iter__(self):
-        while True:
-            item = self._q.get()
-            if item is None:
-                if self._err is not None:
-                    raise self._err
-                return
-            yield item
+        try:
+            while True:
+                item = self._q.get()
+                if item is None:
+                    if self._err is not None:
+                        raise self._err
+                    return
+                yield item
+        finally:
+            self._stop.set()
 
 
 class AnchorLoader:

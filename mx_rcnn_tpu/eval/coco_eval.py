@@ -78,26 +78,45 @@ class COCOEval:
         self._dts = defaultdict(list)
         for d in results:
             self._dts[d["image_id"], d["category_id"]].append(d)
+        self._cache: dict = {}
 
     # -- per (image, category) matching --------------------------------------
-    def _compute_iou(self, img_id: int, cat_id: int, dts: list, gts: list):
+    def _prepared(self, img_id: int, cat_id: int):
+        """Score-sorted dets, gts, IoU matrix and det areas for one
+        (image, category) — computed ONCE and reused across all
+        (area_rng, max_det) cells (pycocotools computeIoU does the same)."""
+        key = (img_id, cat_id)
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        gts = self._gts[key]
+        dts = self._dts[key]
+        d_order = np.argsort([-d["score"] for d in dts], kind="stable")
+        dts = [dts[i] for i in d_order]
         iscrowd = np.asarray([g.get("iscrowd", 0) for g in gts], bool)
         if self.iou_type == "bbox":
             dt = np.asarray([d["bbox"] for d in dts], np.float64).reshape(-1, 4)
             gt = np.asarray([g["bbox"] for g in gts], np.float64).reshape(-1, 4)
-            return bbox_iou_xywh(dt, gt, iscrowd)
-        from mx_rcnn_tpu.eval.mask_rle import ann_to_rle, rle_iou
+            ious = bbox_iou_xywh(dt, gt, iscrowd)
+            d_area = dt[:, 2] * dt[:, 3]
+        else:
+            from mx_rcnn_tpu.eval.mask_rle import ann_to_rle, area, rle_iou
 
-        im = self.imgs[img_id]
-        h, w = im["height"], im["width"]
-        dr = [ann_to_rle(d["segmentation"], h, w) for d in dts]
-        gr = [ann_to_rle(g["segmentation"], h, w) for g in gts]
-        return rle_iou(dr, gr, iscrowd)
+            im = self.imgs[img_id]
+            h, w = im["height"], im["width"]
+            dr = [ann_to_rle(d["segmentation"], h, w) for d in dts]
+            gr = [ann_to_rle(g["segmentation"], h, w) for g in gts]
+            ious = rle_iou(dr, gr, iscrowd)
+            # pycocotools loadRes materializes det area from the mask
+            d_area = np.asarray([d.get("area") or area(r)
+                                 for d, r in zip(dts, dr)], np.float64)
+        out = (dts, gts, ious, d_area)
+        self._cache[key] = out
+        return out
 
     def _evaluate_img(self, img_id: int, cat_id: int, area_rng, max_det: int):
-        gts = self._gts[img_id, cat_id]
-        dts = self._dts[img_id, cat_id]
-        if not gts and not dts:
+        dts_all, gts, ious_all, d_area_all = self._prepared(img_id, cat_id)
+        if not gts and not dts_all:
             return None
         gt_ignore = np.asarray(
             [g.get("iscrowd", 0) or g.get("ignore", 0)
@@ -109,10 +128,9 @@ class COCOEval:
         gt_ignore = gt_ignore[g_order]
         iscrowd = np.asarray([g.get("iscrowd", 0) for g in gts], bool)
 
-        d_order = np.argsort([-d["score"] for d in dts], kind="stable")[:max_det]
-        dts = [dts[i] for i in d_order]
-
-        ious = self._compute_iou(img_id, cat_id, dts, gts)
+        dts = dts_all[:max_det]
+        d_area = d_area_all[:max_det]
+        ious = ious_all[:max_det][:, g_order] if len(gts) else ious_all[:max_det]
 
         T, D, G = len(IOU_THRS), len(dts), len(gts)
         dt_match = np.zeros((T, D), np.int64)
@@ -139,10 +157,6 @@ class COCOEval:
                 dt_match[ti, di] = 1
                 gt_match[ti, m] = di + 1
         # unmatched dets outside the area range are ignored, not FP
-        if self.iou_type == "bbox":
-            d_area = np.asarray([d["bbox"][2] * d["bbox"][3] for d in dts])
-        else:
-            d_area = np.asarray([d.get("area", 0) for d in dts])
         out_of_rng = (d_area < area_rng[0]) | (d_area > area_rng[1])
         dt_ignore |= (dt_match == 0) & out_of_rng[None, :]
         return {

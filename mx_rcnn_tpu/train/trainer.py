@@ -25,6 +25,18 @@ from mx_rcnn_tpu.train.metric import MetricBank
 from mx_rcnn_tpu.train.train_step import TrainState, create_train_state, make_train_step
 
 
+def _reset_schedule_counts(opt_state):
+    """Zero every ``count`` leaf in an optax state tree."""
+
+    def reset(path, leaf):
+        names = [getattr(e, "name", getattr(e, "key", "")) for e in path]
+        if names and names[-1] == "count":
+            return jax.numpy.zeros_like(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(reset, opt_state)
+
+
 def fit(cfg: Config, model, params, train_loader,
         begin_epoch: int = 0, end_epoch: int = 10,
         plan: Optional[MeshPlan] = None,
@@ -56,6 +68,12 @@ def fit(cfg: Config, model, params, train_loader,
             {"params": state.params, "opt_state": state.opt_state, "step": 0})
         r_params, r_opt, r_step = ckpt.load_epoch(
             begin_epoch, cfg, for_training=True, abstract_payload=abstract)
+        if r_opt is not None:
+            # the LR schedule was rebuilt with boundaries relative to
+            # begin_epoch (make_lr_schedule), so its step count must restart
+            # at 0 — only momentum buffers carry over.  Keeping the saved
+            # global count would fire every LR drop begin_epoch epochs early.
+            r_opt = _reset_schedule_counts(r_opt)
         state = TrainState(step=jax.numpy.asarray(r_step, jax.numpy.int32),
                            params=r_params,
                            opt_state=r_opt if r_opt is not None else state.opt_state)
@@ -72,15 +90,19 @@ def fit(cfg: Config, model, params, train_loader,
     for epoch in range(begin_epoch, end_epoch):
         bank.reset()
         speedo.reset()
-        pending = None  # metrics fetched one step late: device stays ahead
+        pending = None
         for i, batch in enumerate(train_loader):
             key, sub = jax.random.split(key)
             if plan is not None:
                 batch = shard_batch(plan, batch)
             state, metrics = step_fn(state, batch, sub)
-            if pending is not None:
-                bank.update(jax.device_get(pending))
             pending = metrics
+            # fetch metrics only at Speedometer cadence: a device→host scalar
+            # read stalls the dispatch pipeline (and on tunneled devices costs
+            # far more than a step), so per-step reads would serialize training
+            if (i + 1) % frequent == 0:
+                bank.update(jax.device_get(pending))
+                pending = None
             speedo(epoch, i, bank.format())
         if pending is not None:
             bank.update(jax.device_get(pending))
