@@ -27,8 +27,12 @@ from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.image import get_image, resize_to_bucket, transform_image
 
 
-def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int]) -> dict:
-    """roidb record → one transformed sample (host numpy)."""
+def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
+                 with_masks: bool = False) -> dict:
+    """roidb record → one transformed sample (host numpy).
+
+    ``with_masks``: rasterize gt masks (train loaders under HAS_MASK only —
+    eval and proposal loaders never consume them)."""
     if "image_array" in rec:  # synthetic dataset ships pixels inline
         im = rec["image_array"]
         if rec.get("flipped", False):
@@ -48,9 +52,16 @@ def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int]) -> dict:
         boxes[:n] = rec["boxes"][:n] * s  # gt scaled into the resized frame
         classes[:n] = rec["gt_classes"][:n]
         valid[:n] = True
-    return dict(images=padded,
-                im_info=np.asarray([eh, ew, s], np.float32),
-                gt_boxes=boxes, gt_classes=classes, gt_valid=valid)
+    out = dict(images=padded,
+               im_info=np.asarray([eh, ew, s], np.float32),
+               gt_boxes=boxes, gt_classes=classes, gt_valid=valid)
+    if with_masks and cfg.network.HAS_MASK:
+        from mx_rcnn_tpu.data.mask import rasterize_gt_masks
+
+        out["gt_masks"] = rasterize_gt_masks(
+            rec.get("segmentation"), rec["boxes"], rec["width"],
+            rec.get("flipped", False), g)
+    return out
 
 
 def _stack(samples: List[dict]) -> Dict[str, np.ndarray]:
@@ -168,7 +179,8 @@ class AnchorLoader:
     def _produce(self) -> Iterator[Dict[str, np.ndarray]]:
         scale = self.cfg.tpu.SCALES[0]
         for chunk in self._epoch_indices():
-            yield _stack([_load_record(self.roidb[i], self.cfg, scale)
+            yield _stack([_load_record(self.roidb[i], self.cfg, scale,
+                                       with_masks=True)
                           for i in chunk])
 
     def __iter__(self):

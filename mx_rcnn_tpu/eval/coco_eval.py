@@ -100,7 +100,8 @@ class COCOEval:
             ious = bbox_iou_xywh(dt, gt, iscrowd)
             d_area = dt[:, 2] * dt[:, 3]
         else:
-            from mx_rcnn_tpu.eval.mask_rle import ann_to_rle, area, rle_iou
+            from mx_rcnn_tpu.eval.mask_rle import ann_to_rle, area
+            from mx_rcnn_tpu.native import rle_iou  # C++ run-merge fast path
 
             im = self.imgs[img_id]
             h, w = im["height"], im["width"]

@@ -20,8 +20,8 @@ import numpy as np
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.loader import TestLoader
 from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.native import nms  # C++ fast path, numpy fallback inside
 from mx_rcnn_tpu.ops.boxes import bbox_pred as decode_boxes, clip_boxes
-from mx_rcnn_tpu.ops.nms import nms
 
 
 class Predictor:

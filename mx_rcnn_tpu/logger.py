@@ -8,3 +8,6 @@ logging.basicConfig(
 )
 logger = logging.getLogger("mx_rcnn_tpu")
 logger.setLevel(logging.INFO)
+
+# orbax/absl emit per-checkpoint INFO spam; keep driver output readable
+logging.getLogger("absl").setLevel(logging.WARNING)

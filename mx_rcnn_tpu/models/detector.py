@@ -86,7 +86,8 @@ class FasterRCNN(nn.Module):
 
     # ---- train graph (reference get_*_train) -------------------------------
 
-    def __call__(self, images, im_info, gt_boxes, gt_classes, gt_valid, key):
+    def __call__(self, images, im_info, gt_boxes, gt_classes, gt_valid, key,
+                 gt_masks=None):
         """One training forward pass.
 
         Args:
@@ -94,9 +95,12 @@ class FasterRCNN(nn.Module):
           im_info: (B, 3) float32 — (effective_h, effective_w, scale).
           gt_boxes: (B, G, 4); gt_classes: (B, G) int32; gt_valid: (B, G) bool.
           key: PRNG key for in-graph sampling.
+          gt_masks: accepted for loader compatibility; the classic graph has
+            no mask head and ignores it (FPN variant consumes it).
 
         Returns (total_loss, aux) with the six reference metrics' raw pieces.
         """
+        del gt_masks
         cfg = self.cfg
         tr = cfg.TRAIN
         B = images.shape[0]
@@ -127,7 +131,7 @@ class FasterRCNN(nn.Module):
                 s, d, anchors, info[0], info[1], info[2],
                 pre_nms_top_n=tr.RPN_PRE_NMS_TOP_N, post_nms_top_n=tr.RPN_POST_NMS_TOP_N,
                 nms_thresh=tr.RPN_NMS_THRESH, min_size=tr.RPN_MIN_SIZE,
-                use_pallas=False)
+                use_pallas=tr.CXX_PROPOSAL)
         )(fg_score, rpn_bbox_sg, im_info)
 
         # --- ProposalTarget: append gt, sample 128 RoIs with targets ---
@@ -190,7 +194,7 @@ class FasterRCNN(nn.Module):
                 s, d, anchors, info[0], info[1], info[2],
                 pre_nms_top_n=te.RPN_PRE_NMS_TOP_N, post_nms_top_n=te.RPN_POST_NMS_TOP_N,
                 nms_thresh=te.RPN_NMS_THRESH, min_size=te.RPN_MIN_SIZE,
-                use_pallas=False)
+                use_pallas=te.CXX_PROPOSAL)
         )(fg_score, rpn_bbox, im_info)
         cls_logits, bbox_deltas = self._rcnn_head(feat, rois, deterministic=True)
         cls_prob = jax.nn.softmax(cls_logits, axis=-1)
@@ -209,7 +213,7 @@ class FasterRCNN(nn.Module):
                 s, d, anchors, info[0], info[1], info[2],
                 pre_nms_top_n=te.RPN_PRE_NMS_TOP_N, post_nms_top_n=te.RPN_POST_NMS_TOP_N,
                 nms_thresh=te.RPN_NMS_THRESH, min_size=te.RPN_MIN_SIZE,
-                use_pallas=False)
+                use_pallas=te.CXX_PROPOSAL)
         )(fg_score, rpn_bbox, im_info)
 
     def rpn_train(self, images, im_info, gt_boxes, gt_valid, key):
@@ -295,6 +299,13 @@ def init_params(model: FasterRCNN, cfg: Config, key, batch_size: int = 1,
     h, w = image_hw
     g = cfg.tpu.MAX_GT
     k1, k2 = jax.random.split(key)
+    kwargs = {}
+    if cfg.network.HAS_MASK:
+        from mx_rcnn_tpu.data.mask import GT_MASK_SIZE
+
+        # mask_head params only materialize if the mask branch traces at init
+        kwargs["gt_masks"] = jnp.zeros(
+            (batch_size, g, GT_MASK_SIZE, GT_MASK_SIZE), jnp.float32)
     dummy = dict(
         images=jnp.zeros((batch_size, h, w, 3), jnp.float32),
         im_info=jnp.tile(jnp.asarray([[h, w, 1.0]], jnp.float32), (batch_size, 1)),
@@ -304,5 +315,5 @@ def init_params(model: FasterRCNN, cfg: Config, key, batch_size: int = 1,
     )
     variables = model.init({"params": k1, "dropout": k2}, dummy["images"],
                            dummy["im_info"], dummy["gt_boxes"], dummy["gt_classes"],
-                           dummy["gt_valid"], k2)
+                           dummy["gt_valid"], k2, **kwargs)
     return variables["params"]

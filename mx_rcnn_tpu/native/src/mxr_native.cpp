@@ -1,0 +1,124 @@
+// Native CPU kernels for the host-side eval tier.
+//
+// TPU-native counterpart of the reference's in-repo native code
+// (rcnn/cython/bbox.pyx, rcnn/cython/cpu_nms.pyx, and the vendored
+// pycocotools C RLE ops in rcnn/pycocotools/maskApi.c — behavior
+// re-implemented from the contracts pinned by tests/oracles, not copied).
+// The TPU compute path never calls these; they serve pred_eval's per-class
+// NMS and COCO mask IoU, which run on host.
+//
+// Exposed as extern "C" with raw pointers; loaded via ctypes
+// (mx_rcnn_tpu/native/__init__.py). Build: `make -C mx_rcnn_tpu/native`.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// (N,4) x (K,4) -> (N,K) IoU matrix, legacy +1 areas (bbox_overlaps).
+void mxr_bbox_overlaps(const float* boxes, int64_t n, const float* query,
+                       int64_t k, float* out) {
+  for (int64_t j = 0; j < k; ++j) {
+    const float qx1 = query[j * 4], qy1 = query[j * 4 + 1];
+    const float qx2 = query[j * 4 + 2], qy2 = query[j * 4 + 3];
+    const float qarea = (qx2 - qx1 + 1.f) * (qy2 - qy1 + 1.f);
+    for (int64_t i = 0; i < n; ++i) {
+      const float bx1 = boxes[i * 4], by1 = boxes[i * 4 + 1];
+      const float bx2 = boxes[i * 4 + 2], by2 = boxes[i * 4 + 3];
+      const float iw = std::min(bx2, qx2) - std::max(bx1, qx1) + 1.f;
+      if (iw <= 0.f) { out[i * k + j] = 0.f; continue; }
+      const float ih = std::min(by2, qy2) - std::max(by1, qy1) + 1.f;
+      if (ih <= 0.f) { out[i * k + j] = 0.f; continue; }
+      const float barea = (bx2 - bx1 + 1.f) * (by2 - by1 + 1.f);
+      const float inter = iw * ih;
+      out[i * k + j] = inter / (barea + qarea - inter);
+    }
+  }
+}
+
+// Greedy NMS over (N,5) [x1,y1,x2,y2,score]; writes kept indices to
+// keep_out (caller allocates N), returns the kept count.
+int64_t mxr_nms(const float* dets, int64_t n, float thresh,
+                int64_t* keep_out) {
+  std::vector<int64_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    return dets[a * 5 + 4] > dets[b * 5 + 4];
+  });
+  std::vector<char> removed(n, 0);
+  std::vector<float> area(n);
+  for (int64_t i = 0; i < n; ++i)
+    area[i] = (dets[i * 5 + 2] - dets[i * 5] + 1.f) *
+              (dets[i * 5 + 3] - dets[i * 5 + 1] + 1.f);
+  int64_t kept = 0;
+  for (int64_t oi = 0; oi < n; ++oi) {
+    const int64_t i = order[oi];
+    if (removed[i]) continue;
+    keep_out[kept++] = i;
+    const float ix1 = dets[i * 5], iy1 = dets[i * 5 + 1];
+    const float ix2 = dets[i * 5 + 2], iy2 = dets[i * 5 + 3];
+    for (int64_t oj = oi + 1; oj < n; ++oj) {
+      const int64_t j = order[oj];
+      if (removed[j]) continue;
+      const float iw =
+          std::min(ix2, dets[j * 5 + 2]) - std::max(ix1, dets[j * 5]) + 1.f;
+      if (iw <= 0.f) continue;
+      const float ih = std::min(iy2, dets[j * 5 + 3]) -
+                       std::max(iy1, dets[j * 5 + 1]) + 1.f;
+      if (ih <= 0.f) continue;
+      const float inter = iw * ih;
+      if (inter / (area[i] + area[j] - inter) > thresh) removed[j] = 1;
+    }
+  }
+  return kept;
+}
+
+// |A n B| for two column-major RLEs (counts arrays) over n pixels.
+int64_t mxr_rle_intersect(const uint32_t* a, int64_t na, const uint32_t* b,
+                          int64_t nb, int64_t n) {
+  int64_t ia = 0, ib = 0, pos = 0, inter = 0;
+  int64_t ca = na > 0 ? (int64_t)a[0] : n;
+  int64_t cb = nb > 0 ? (int64_t)b[0] : n;
+  int va = 0, vb = 0;
+  while (pos < n) {
+    int64_t step = std::min(ca, cb);
+    if (step <= 0) step = 1;  // defensive: zero-length run
+    if (va && vb) inter += step;
+    ca -= step; cb -= step; pos += step;
+    if (ca == 0) {
+      ++ia;
+      ca = ia < na ? (int64_t)a[ia] : n;
+      va ^= 1;
+    }
+    if (cb == 0) {
+      ++ib;
+      cb = ib < nb ? (int64_t)b[ib] : n;
+      vb ^= 1;
+    }
+  }
+  return inter;
+}
+
+// (D x G) RLE IoU with crowd semantics. Counts are flattened with offsets
+// (CSR-style): d_counts/d_off (D+1), g_counts/g_off (G+1).
+void mxr_rle_iou(const uint32_t* d_counts, const int64_t* d_off, int64_t D,
+                 const uint32_t* g_counts, const int64_t* g_off, int64_t G,
+                 const int64_t* d_area, const int64_t* g_area,
+                 const uint8_t* g_crowd, int64_t n, double* out) {
+  for (int64_t i = 0; i < D; ++i) {
+    for (int64_t j = 0; j < G; ++j) {
+      const int64_t inter =
+          mxr_rle_intersect(d_counts + d_off[i], d_off[i + 1] - d_off[i],
+                            g_counts + g_off[j], g_off[j + 1] - g_off[j], n);
+      const double uni = g_crowd[j]
+                             ? (double)d_area[i]
+                             : (double)d_area[i] + g_area[j] - inter;
+      out[i * G + j] = uni > 0 ? inter / uni : 0.0;
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,68 @@
+"""Mask-head training targets, in-graph.
+
+Contract (Mask R-CNN paper §3, and the standard crop-resize
+implementation): for each sampled fg RoI, the target is its matched gt
+instance mask cropped to the RoI and resampled to MASK_SIZE², values {0,1}.
+
+The data layer rasterizes each gt polygon ONCE into a fixed-resolution crop
+aligned to the gt box (``gt_masks``: (G, S, S), gt-box frame).  In-graph we
+map each RoI's 28×28 grid into that gt-box frame and bilinearly sample —
+fully static shapes, no polygon math on device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("out_size",))
+def mask_targets_for_rois(gt_masks: jnp.ndarray, gt_boxes: jnp.ndarray,
+                          rois: jnp.ndarray, gt_index: jnp.ndarray,
+                          *, out_size: int = 28) -> jnp.ndarray:
+    """(G, S, S) gt-box-frame masks → (R, out, out) per-RoI targets.
+
+    Args:
+      gt_masks: (G, S, S) float or bool, mask of gt g in its own box frame.
+      gt_boxes: (G, 4) the frames those masks live in (scaled image coords).
+      rois: (R, 4) sampled rois (scaled image coords).
+      gt_index: (R,) index of the matched gt per roi.
+    """
+    g, s, _ = gt_masks.shape
+    r = rois.shape[0]
+
+    box = gt_boxes[gt_index]                      # (R, 4)
+    bw = jnp.maximum(box[:, 2] - box[:, 0], 1e-3)
+    bh = jnp.maximum(box[:, 3] - box[:, 1], 1e-3)
+
+    # RoI pixel-center grid in image coords
+    ys = (jnp.arange(out_size, dtype=jnp.float32) + 0.5) / out_size
+    xs = (jnp.arange(out_size, dtype=jnp.float32) + 0.5) / out_size
+    gy = rois[:, 1:2] + ys[None, :] * (rois[:, 3:4] - rois[:, 1:2])  # (R, out)
+    gx = rois[:, 0:1] + xs[None, :] * (rois[:, 2:3] - rois[:, 0:1])
+
+    # map into the gt-box frame [0, S)
+    my = (gy - box[:, 1:2]) / bh[:, None] * s - 0.5   # (R, out)
+    mx = (gx - box[:, 0:1]) / bw[:, None] * s - 0.5
+
+    masks = gt_masks[gt_index].astype(jnp.float32)    # (R, S, S)
+
+    def sample_one(m, yy, xx):
+        yy2 = jnp.broadcast_to(yy[:, None], (out_size, out_size))
+        xx2 = jnp.broadcast_to(xx[None, :], (out_size, out_size))
+        inside = (yy2 > -1.0) & (yy2 < s) & (xx2 > -1.0) & (xx2 < s)
+        y0 = jnp.clip(jnp.floor(yy2), 0, s - 1)
+        x0 = jnp.clip(jnp.floor(xx2), 0, s - 1)
+        y1 = jnp.clip(y0 + 1, 0, s - 1)
+        x1 = jnp.clip(x0 + 1, 0, s - 1)
+        ly = jnp.clip(yy2 - y0, 0.0, 1.0)
+        lx = jnp.clip(xx2 - x0, 0.0, 1.0)
+        y0i, x0i, y1i, x1i = (a.astype(jnp.int32) for a in (y0, x0, y1, x1))
+        v = ((1 - ly) * (1 - lx) * m[y0i, x0i] + (1 - ly) * lx * m[y0i, x1i]
+             + ly * (1 - lx) * m[y1i, x0i] + ly * lx * m[y1i, x1i])
+        return jnp.where(inside, v, 0.0)
+
+    out = jax.vmap(sample_one)(masks, my, mx)         # (R, out, out)
+    return (out >= 0.5).astype(jnp.float32)

@@ -91,3 +91,61 @@ def propose(
     # duplicate-pad: invalid slots point at keep_idx 0 (the top box) already,
     # because nms_padded emits index 0 for empty slots; mask tells the truth.
     return rois, roi_scores, keep_mask
+
+
+def propose_fpn(
+    level_scores,
+    level_deltas,
+    level_anchors,
+    im_h,
+    im_w,
+    im_scale,
+    *,
+    pre_nms_top_n: int = 12000,
+    post_nms_top_n: int = 2000,
+    nms_thresh: float = 0.7,
+    min_size: int = 16,
+    use_pallas: bool = False,
+):
+    """Multi-level proposal generation (FPN): per-level decode + top-k
+    (pre_nms_top_n split evenly across levels, the Detectron per-level cap),
+    concat, then ONE joint NMS to post_nms_top_n.
+
+    Args are parallel lists over pyramid levels; same per-image contract and
+    return shape as ``propose``.
+    """
+    nl = len(level_scores)
+    k_level = max(pre_nms_top_n // nl, 1)
+    cand_boxes, cand_scores = [], []
+    for scores, deltas, anchors in zip(level_scores, level_deltas,
+                                       level_anchors):
+        boxes = bbox_pred(anchors, deltas)
+        boxes = clip_boxes(boxes, im_h, im_w)
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        ms = min_size * im_scale
+        scores = jnp.where((ws >= ms) & (hs >= ms), scores, -1.0)
+        k = min(k_level, scores.shape[0])
+        top_scores, top_idx = jax.lax.top_k(scores, k)
+        cand_boxes.append(boxes[top_idx])
+        cand_scores.append(top_scores)
+    boxes = jnp.concatenate(cand_boxes, axis=0)
+    scores = jnp.concatenate(cand_scores, axis=0)
+    # global score sort: each level's top-k is sorted internally but not
+    # across levels, and the NMS backends' greedy order (and the Pallas
+    # sweep's index order) must be score-descending
+    order = jnp.argsort(-scores)
+    boxes = boxes[order]
+    scores = scores[order]
+    valid = scores > -0.5
+
+    if use_pallas:
+        from mx_rcnn_tpu.kernels.nms_pallas import nms_pallas
+        keep_idx, keep_mask = nms_pallas(boxes, scores, max_out=post_nms_top_n,
+                                         iou_thresh=nms_thresh, valid=valid)
+    else:
+        keep_idx, keep_mask = nms_padded(boxes, scores, max_out=post_nms_top_n,
+                                         iou_thresh=nms_thresh, valid=valid)
+    rois = boxes[keep_idx]
+    roi_scores = jnp.where(keep_mask, scores[keep_idx], 0.0)
+    return rois, roi_scores, keep_mask

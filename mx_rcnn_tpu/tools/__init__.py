@@ -1,0 +1,3 @@
+"""Stage tools + shared CLI plumbing (reference ``rcnn/tools/`` —
+train_rpn / test_rpn / train_rcnn / reeval — and the argv surface shared by
+the root drivers train_end2end.py / test.py / demo.py)."""

@@ -1,0 +1,200 @@
+"""Shared driver plumbing: dataset construction, param init/loading, mesh
+setup — the glue the reference spreads across ``train_end2end.py:train_net``
+and ``rcnn/tools/*`` (load_param, generate_config calls, ctx parsing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import Config, generate_config, list_datasets, list_networks
+from mx_rcnn_tpu.data import SyntheticDataset
+from mx_rcnn_tpu.data.pascal_voc import PascalVOC
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.models import build_model, init_params
+from mx_rcnn_tpu.parallel import MeshPlan, make_mesh
+from mx_rcnn_tpu.train.checkpoint import load_params_npz, normalize_for_train
+
+
+def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
+    """The reference's shared argparse surface (names kept; GPU-specific
+    flags get TPU equivalents)."""
+    parser.add_argument("--network", default="resnet101", choices=list_networks())
+    parser.add_argument("--dataset", default="PascalVOC", choices=list_datasets())
+    parser.add_argument("--image_set", default=None,
+                        help="override the preset image set")
+    parser.add_argument("--root_path", default="data")
+    parser.add_argument("--dataset_path", default=None)
+    parser.add_argument("--prefix", default="model/e2e",
+                        help="checkpoint prefix (directory for orbax)")
+    # TPU equivalents of --gpus/--ctx: how many mesh devices to use
+    parser.add_argument("--devices", type=int, default=0,
+                        help="data-mesh size; 0 = all visible devices")
+    # zero-data-on-disk mode (no reference counterpart)
+    parser.add_argument("--synthetic", action="store_true",
+                        help="use the synthetic dataset (no files needed)")
+    parser.add_argument("--synthetic_images", type=int, default=64)
+    if train:
+        parser.add_argument("--pretrained", default="",
+                            help=".npz backbone/params path (converted)")
+        parser.add_argument("--pretrained_epoch", type=int, default=0)
+        parser.add_argument("--begin_epoch", type=int, default=0)
+        parser.add_argument("--end_epoch", type=int, default=10)
+        parser.add_argument("--lr", type=float, default=None)
+        parser.add_argument("--lr_step", default=None,
+                            help="comma-separated epochs, e.g. '7'")
+        parser.add_argument("--frequent", type=int, default=20)
+        parser.add_argument("--no_flip", action="store_true")
+        parser.add_argument("--no_shuffle", action="store_true")
+        parser.add_argument("--resume", action="store_true")
+        parser.add_argument("--batch_images", type=int, default=None,
+                            help="GLOBAL images per step (default: 1 per device)")
+        parser.add_argument("--num-steps", type=int, default=0, dest="num_steps",
+                            help="cap steps per epoch (smoke runs)")
+    else:
+        parser.add_argument("--epoch", type=int, default=10,
+                            help="checkpoint epoch to load")
+        parser.add_argument("--vis", action="store_true")
+        parser.add_argument("--thresh", type=float, default=1e-3)
+    return parser
+
+
+def config_from_args(args, train: bool = True) -> Config:
+    overrides = {}
+    if train:
+        if args.lr is not None:
+            overrides["TRAIN__LR"] = args.lr
+        if args.lr_step is not None:
+            overrides["TRAIN__LR_STEP"] = tuple(
+                int(e) for e in str(args.lr_step).split(","))
+        if getattr(args, "no_flip", False):
+            overrides["TRAIN__FLIP"] = False
+        if getattr(args, "no_shuffle", False):
+            overrides["TRAIN__SHUFFLE"] = False
+    cfg = generate_config(args.network, args.dataset, **overrides)
+    if args.image_set:
+        cfg = cfg.replace(dataset=dataclasses.replace(
+            cfg.dataset, IMAGE_SET=args.image_set))
+    if args.dataset_path:
+        cfg = cfg.replace(dataset=dataclasses.replace(
+            cfg.dataset, DATASET_PATH=args.dataset_path))
+    if args.synthetic:
+        # from-scratch-friendly: normalize pixel scale (pretrained weights
+        # absorb it in the reference contract; random init cannot)
+        cfg = cfg.replace(network=dataclasses.replace(
+            cfg.network, PIXEL_STDS=(127.0, 127.0, 127.0)))
+    return cfg
+
+
+def get_imdb(args, cfg: Config, test: bool = False):
+    """Dataset factory (reference: the imdb dispatch in train/test drivers)."""
+    if args.synthetic:
+        s = cfg.tpu.SCALES[0]
+        return SyntheticDataset(num_images=args.synthetic_images,
+                                num_classes=cfg.NUM_CLASSES,
+                                height=s[0], width=s[1])
+    name = cfg.dataset.DATASET
+    image_set = cfg.dataset.TEST_IMAGE_SET if test else cfg.dataset.IMAGE_SET
+    if name == "PascalVOC":
+        return PascalVOC(image_set, args.root_path, cfg.dataset.DATASET_PATH)
+    if name == "coco":
+        from mx_rcnn_tpu.data.coco_dataset import COCODataset
+
+        return COCODataset(image_set, args.root_path, cfg.dataset.DATASET_PATH)
+    raise KeyError(name)
+
+
+def get_train_roidb(imdb, cfg: Config):
+    roidb = imdb.gt_roidb()
+    if cfg.TRAIN.FLIP:
+        roidb = imdb.append_flipped_images(roidb)
+    return imdb.filter_roidb(roidb)
+
+
+def make_plan(args) -> Optional[MeshPlan]:
+    n = args.devices if args.devices > 0 else len(jax.devices())
+    if n <= 1:
+        return None
+    return make_mesh(jax.devices()[:n], data=n)
+
+
+def init_or_load_params(args, cfg: Config, model, batch_size: int,
+                        key=None):
+    """Random-init params, then overlay pretrained weights if given
+    (reference load_param + Normal-init of new heads in train_net)."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    del batch_size  # init shapes don't depend on it
+    params = init_params(model, cfg, key, batch_size=1)
+    if args.pretrained:
+        path = args.pretrained
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        loaded = load_params_npz(path)
+        params = _overlay(params, loaded)
+        logger.info("loaded pretrained params from %s", path)
+    return params
+
+
+def _overlay(params, loaded):
+    """Copy leaves from ``loaded`` into ``params`` where paths+shapes match
+    (partial restore: backbone-only checkpoints leave heads at init)."""
+    import jax.numpy as jnp
+
+    def walk(dst, src, path=""):
+        out = {}
+        for k, v in dst.items():
+            if isinstance(v, dict):
+                out[k] = walk(v, src.get(k, {}), path + k + "/")
+            elif k in src and np.shape(src[k]) == np.shape(v):
+                out[k] = jnp.asarray(src[k])
+            else:
+                if k in src:
+                    logger.warning("shape mismatch at %s%s: %s vs %s — kept init",
+                                   path, k, np.shape(src[k]), np.shape(v))
+                out[k] = v
+        return out
+
+    return walk(params, loaded)
+
+
+class CappedLoader:
+    """Wraps a loader to at most ``n`` steps per epoch (smoke runs)."""
+
+    def __init__(self, inner, n: int):
+        self._inner = inner
+        self._n = n
+        self.batch_size = inner.batch_size
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return min(self._n, self._inner.steps_per_epoch)
+
+    def __len__(self):
+        return self.steps_per_epoch
+
+    def __iter__(self):
+        it = iter(self._inner)
+        for i, batch in enumerate(it):
+            if i >= self._n:
+                close = getattr(it, "close", None)
+                if close:
+                    close()
+                break
+            yield batch
+
+
+def load_eval_params(args, cfg: Config, model):
+    """Load a saved checkpoint for inference (de-normalized params — see
+    train/checkpoint.py contract)."""
+    from mx_rcnn_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(args.prefix)
+    params, _, _ = mgr.load_epoch(args.epoch, cfg, for_training=False)
+    return params

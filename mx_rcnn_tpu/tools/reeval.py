@@ -1,0 +1,34 @@
+"""Stage tool: re-score cached detections (reference
+``rcnn/tools/reeval.py``): load a pickled ``all_boxes`` and run
+``imdb.evaluate_detections`` again — no model, no device."""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.tools.common import add_common_args, config_from_args, get_imdb
+
+
+def reeval(args):
+    cfg = config_from_args(args, train=False)
+    imdb = get_imdb(args, cfg, test=True)
+    with open(args.detections, "rb") as f:
+        all_boxes = pickle.load(f)
+    stats = imdb.evaluate_detections(all_boxes)
+    logger.info("reeval: %s", {k: round(float(v), 4) for k, v in stats.items()
+                               if isinstance(v, (int, float))})
+    return stats
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="Re-evaluate cached detections")
+    add_common_args(parser, train=False)
+    parser.add_argument("--detections", required=True,
+                        help="pickled all_boxes path")
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    reeval(parse_args())

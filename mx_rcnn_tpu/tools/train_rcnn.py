@@ -1,0 +1,53 @@
+"""Stage tool: Fast-RCNN training on cached proposals (reference
+``rcnn/tools/train_rcnn.py`` — alternate-training steps 3 and 6): ROIIter
+ships proposals; sampling happens in-graph (``FasterRCNN.rcnn_train``)."""
+
+from __future__ import annotations
+
+import argparse
+
+from mx_rcnn_tpu.data import ROIIter
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.tools.common import (CappedLoader, add_common_args,
+                                      config_from_args, get_imdb,
+                                      get_train_roidb, init_or_load_params,
+                                      make_plan)
+from mx_rcnn_tpu.train import fit
+
+
+def train_rcnn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
+    cfg = cfg or config_from_args(args, train=True)
+    plan = make_plan(args)
+    n_dev = plan.n_data if plan else 1
+    batch_size = (getattr(args, "batch_images", None)
+                  or n_dev * cfg.TRAIN.BATCH_IMAGES)
+    if roidb is None:
+        imdb = get_imdb(args, cfg)
+        roidb = get_train_roidb(imdb, cfg)
+    if not any("proposals" in r for r in roidb):
+        raise ValueError("roidb has no cached proposals — run test_rpn first")
+    loader = ROIIter(roidb, cfg, batch_size, shuffle=cfg.TRAIN.SHUFFLE)
+    if getattr(args, "num_steps", 0):
+        loader = CappedLoader(loader, args.num_steps)
+    model = build_model(cfg)
+    if params is None:
+        params = init_or_load_params(args, cfg, model, batch_size)
+    fixed = (cfg.network.FIXED_PARAMS_SHARED if frozen_shared
+             else cfg.network.FIXED_PARAMS)
+    logger.info("train_rcnn: %d images, frozen=%s", len(roidb), fixed)
+    state = fit(cfg, model, params, loader,
+                begin_epoch=args.begin_epoch, end_epoch=args.end_epoch,
+                plan=plan, prefix=getattr(args, "prefix", None), graph="rcnn",
+                frequent=args.frequent, fixed_prefixes=fixed)
+    return state
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="Train Fast R-CNN on proposals")
+    add_common_args(parser, train=True)
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    train_rcnn(parse_args())

@@ -1,0 +1,53 @@
+"""Stage tool: RPN-only training (reference ``rcnn/tools/train_rpn.py`` —
+alternate-training steps 1 and 4).  Same loader as end2end; the graph is
+``FasterRCNN.rpn_train`` (backbone + RPN heads + RPN losses only)."""
+
+from __future__ import annotations
+
+import argparse
+
+from mx_rcnn_tpu.data import AnchorLoader
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.tools.common import (CappedLoader, add_common_args,
+                                      config_from_args, get_imdb,
+                                      get_train_roidb, init_or_load_params,
+                                      make_plan)
+from mx_rcnn_tpu.train import fit
+
+
+def train_rpn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
+    """Callable both as a CLI stage and from train_alternate (which passes
+    params of the previous stage and frozen_shared=True for round 2)."""
+    cfg = cfg or config_from_args(args, train=True)
+    plan = make_plan(args)
+    n_dev = plan.n_data if plan else 1
+    batch_size = (getattr(args, "batch_images", None)
+                  or n_dev * cfg.TRAIN.BATCH_IMAGES)
+    if roidb is None:
+        imdb = get_imdb(args, cfg)
+        roidb = get_train_roidb(imdb, cfg)
+    loader = AnchorLoader(roidb, cfg, batch_size, shuffle=cfg.TRAIN.SHUFFLE)
+    if getattr(args, "num_steps", 0):
+        loader = CappedLoader(loader, args.num_steps)
+    model = build_model(cfg)
+    if params is None:
+        params = init_or_load_params(args, cfg, model, batch_size)
+    fixed = (cfg.network.FIXED_PARAMS_SHARED if frozen_shared
+             else cfg.network.FIXED_PARAMS)
+    logger.info("train_rpn: %d images, frozen=%s", len(roidb), fixed)
+    state = fit(cfg, model, params, loader,
+                begin_epoch=args.begin_epoch, end_epoch=args.end_epoch,
+                plan=plan, prefix=getattr(args, "prefix", None), graph="rpn",
+                frequent=args.frequent, fixed_prefixes=fixed)
+    return state
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="Train RPN")
+    add_common_args(parser, train=True)
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    train_rpn(parse_args())

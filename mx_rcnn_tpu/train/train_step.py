@@ -40,6 +40,10 @@ class TrainState:
 def create_train_state(cfg: Config, params, steps_per_epoch: int,
                        begin_epoch: int = 0,
                        fixed_prefixes=None) -> tuple[TrainState, optax.GradientTransformation]:
+    # copy params into the state: the jitted step donates its state, and
+    # aliasing the caller's buffers would delete them after the first step
+    # (the alternate-training driver reuses one init tree across stages)
+    params = jax.tree.map(lambda x: jnp.array(x, copy=True), params)
     tx, _ = make_optimizer(cfg, steps_per_epoch, params,
                            begin_epoch=begin_epoch, fixed_prefixes=fixed_prefixes)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
@@ -49,10 +53,13 @@ def create_train_state(cfg: Config, params, steps_per_epoch: int,
 def _loss_fn(params, model, batch, key, graph: str):
     """Dispatch to the model's training graph: 'end2end' | 'rpn' | 'rcnn'."""
     if graph == "end2end":
+        kwargs = {}
+        if "gt_masks" in batch:
+            kwargs["gt_masks"] = batch["gt_masks"]
         total, aux = model.apply(
             {"params": params}, batch["images"], batch["im_info"],
             batch["gt_boxes"], batch["gt_classes"], batch["gt_valid"], key,
-            rngs={"dropout": jax.random.fold_in(key, 1)})
+            rngs={"dropout": jax.random.fold_in(key, 1)}, **kwargs)
     elif graph == "rpn":
         total, aux = model.apply(
             {"params": params}, batch["images"], batch["im_info"],

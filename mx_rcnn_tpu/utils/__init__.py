@@ -1,0 +1,5 @@
+"""Param utilities (reference ``rcnn/utils/``: load_model / save_model /
+combine_model).  Load/save live in ``train/checkpoint.py`` (orbax + npz);
+``combine_model`` merges alternate-training stage params."""
+
+from mx_rcnn_tpu.utils.combine_model import combine_model

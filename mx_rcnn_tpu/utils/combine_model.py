@@ -1,0 +1,26 @@
+"""``combine_model`` (reference ``rcnn/utils/combine_model.py``): merge the
+RPN-trained and RCNN-trained parameter trees from 4-step alternate training
+into one deployment tree — backbone + RPN head from the RPN stage,
+RCNN head (head_body + rcnn_out) from the RCNN stage.
+"""
+
+from __future__ import annotations
+
+RPN_KEYS = ("backbone", "rpn")
+RCNN_KEYS = ("head_body", "rcnn_out", "mask_head")
+
+
+def combine_model(rpn_params: dict, rcnn_params: dict) -> dict:
+    """Merge stage params into a single tree for the unified test graph."""
+    out = {}
+    for k in rpn_params:
+        if k in RPN_KEYS:
+            out[k] = rpn_params[k]
+    for k in rcnn_params:
+        if k in RCNN_KEYS:
+            out[k] = rcnn_params[k]
+    missing = [k for k in ("backbone", "rpn", "head_body", "rcnn_out")
+               if k not in out]
+    if missing:
+        raise KeyError(f"combine_model: missing submodules {missing}")
+    return out

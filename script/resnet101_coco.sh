@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# ResNet-101 Faster R-CNN end2end on COCO2017 (BASELINE.json headline config).
+set -e
+python train_end2end.py --network resnet101 --dataset coco \
+  --pretrained model/resnet101_imagenet.npz \
+  --prefix model/resnet101_coco_e2e --end_epoch 8 --lr 0.001 --lr_step 6 "$@"
+python test.py --network resnet101 --dataset coco \
+  --prefix model/resnet101_coco_e2e --epoch 8

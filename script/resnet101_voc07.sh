@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Reference recipe parity (script/resnet_voc07.sh): ResNet-101 Faster R-CNN
+# end2end on VOC07 trainval, eval on VOC07 test.
+set -e
+python train_end2end.py --network resnet101 --dataset PascalVOC \
+  --pretrained model/resnet101_imagenet.npz \
+  --prefix model/resnet101_voc07_e2e --end_epoch 10 --lr 0.001 --lr_step 7 "$@"
+python test.py --network resnet101 --dataset PascalVOC \
+  --prefix model/resnet101_voc07_e2e --epoch 10

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Zero-data smoke: end2end train + eval on the synthetic dataset.
+set -e
+python train_end2end.py --network resnet50 --synthetic --synthetic_images 16 \
+  --prefix /tmp/mxr_smoke --end_epoch 2 --num-steps 4 --frequent 2 "$@"
+python test.py --network resnet50 --synthetic --synthetic_images 16 \
+  --prefix /tmp/mxr_smoke --epoch 2

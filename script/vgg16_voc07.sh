@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Reference recipe parity (script/vgg_voc07.sh): VGG-16 Faster R-CNN end2end.
+set -e
+python train_end2end.py --network vgg16 --dataset PascalVOC \
+  --pretrained model/vgg16_imagenet.npz \
+  --prefix model/vgg16_voc07_e2e --end_epoch 10 --lr 0.001 --lr_step 7 "$@"
+python test.py --network vgg16 --dataset PascalVOC \
+  --prefix model/vgg16_voc07_e2e --epoch 10

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# 4-step alternate training (reference script/vgg_alter_voc07.sh).
+set -e
+python train_alternate.py --network vgg16 --dataset PascalVOC \
+  --pretrained model/vgg16_imagenet.npz \
+  --prefix model/vgg16_voc07_alt --end_epoch 8 "$@"

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Detection evaluation driver (reference ``test.py`` → ``test_rcnn``):
+load checkpoint → TestLoader → pred_eval (per-class NMS, max_per_image) →
+imdb.evaluate_detections (VOC mAP / COCO AP)."""
+
+from __future__ import annotations
+
+import argparse
+
+from mx_rcnn_tpu.data import TestLoader
+from mx_rcnn_tpu.eval import Predictor, pred_eval
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
+                                      get_imdb, load_eval_params)
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="Test a Faster R-CNN network")
+    add_common_args(parser, train=False)
+    parser.add_argument("--batch_images", type=int, default=1)
+    return parser.parse_args()
+
+
+def test_rcnn(args):
+    cfg = config_from_args(args, train=False)
+    imdb = get_imdb(args, cfg, test=True)
+    roidb = imdb.gt_roidb()
+    model = build_model(cfg)
+    params = load_eval_params(args, cfg, model)
+    predictor = Predictor(model, params, cfg)
+    loader = TestLoader(roidb, cfg, batch_size=args.batch_images)
+    stats = pred_eval(predictor, loader, imdb, thresh=args.thresh)
+    logger.info("evaluation done: %s",
+                {k: round(float(v), 4) for k, v in stats.items()
+                 if isinstance(v, (int, float))})
+    return stats
+
+
+if __name__ == "__main__":
+    test_rcnn(parse_args())

@@ -1,0 +1,148 @@
+"""FPN + Mask R-CNN graph tests and mask-target oracle tests."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models import build_model, init_params
+from mx_rcnn_tpu.ops.mask_target import mask_targets_for_rois
+
+
+def fpn_cfg(mask=False):
+    cfg = generate_config(
+        "resnet101_fpn_mask" if mask else "resnet50_fpn", "PascalVOC",
+        TRAIN__RPN_PRE_NMS_TOP_N=500, TRAIN__RPN_POST_NMS_TOP_N=64,
+        TRAIN__BATCH_ROIS=16,
+        TEST__RPN_PRE_NMS_TOP_N=250, TEST__RPN_POST_NMS_TOP_N=32,
+    )
+    net = dataclasses.replace(cfg.network, FPN_ANCHOR_SCALES=(4,),
+                              NETWORK="resnet50",
+                              PIXEL_STDS=(127.0, 127.0, 127.0))
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=4)
+    return cfg.replace(network=net, tpu=tpu)
+
+
+def batch(B=2, H=64, W=96, G=4, seed=0, masks=False):
+    rng = np.random.RandomState(seed)
+    imgs = jnp.asarray(rng.randn(B, H, W, 3), jnp.float32)
+    im_info = jnp.tile(jnp.asarray([[H, W, 1.0]], jnp.float32), (B, 1))
+    gtb = np.zeros((B, G, 4), np.float32)
+    gtv = np.zeros((B, G), bool)
+    gtc = np.zeros((B, G), np.int32)
+    for b in range(B):
+        for g in range(2):
+            x1, y1 = rng.randint(0, W - 40), rng.randint(0, H - 40)
+            gtb[b, g] = (x1, y1, x1 + rng.randint(16, 39), y1 + rng.randint(16, 39))
+            gtc[b, g] = rng.randint(1, 21)
+            gtv[b, g] = True
+    out = [imgs, im_info, jnp.asarray(gtb), jnp.asarray(gtc), jnp.asarray(gtv)]
+    if masks:
+        gm = np.zeros((B, G, 112, 112), np.float32)
+        gm[:, :, :, :56] = 1.0  # left half of every gt box
+        out.append(jnp.asarray(gm))
+    return out
+
+
+def test_fpn_train_graph_and_grads():
+    cfg = fpn_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 2, (64, 96))
+    assert "neck" in params and "lateral2" in params["neck"]
+    imgs, im_info, gtb, gtc, gtv = batch()
+
+    def loss_fn(p, k):
+        return model.apply({"params": p}, imgs, im_info, gtb, gtc, gtv, k,
+                           rngs={"dropout": k})
+
+    (tot, aux), grads = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))(
+        params, jax.random.PRNGKey(1))
+    assert np.isfinite(float(tot))
+    labels = np.asarray(aux["rpn_label"])
+    assert (labels == 1).any() and (labels == 0).any()
+    gn = float(jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                            for g in jax.tree.leaves(grads))))
+    assert np.isfinite(gn) and gn > 0
+
+
+def test_fpn_predict_shapes():
+    cfg = fpn_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 2, (64, 96))
+    imgs, im_info, *_ = batch()
+    rois, valid, cls_prob, deltas, scores = jax.jit(
+        lambda p: model.apply({"params": p}, imgs, im_info,
+                              method=model.predict))(params)
+    R, K = cfg.TEST.RPN_POST_NMS_TOP_N, cfg.NUM_CLASSES
+    assert rois.shape == (2, R, 4)
+    assert cls_prob.shape == (2, R, K)
+    assert deltas.shape == (2, R, 4 * K)
+    assert np.asarray(valid).any()
+    np.testing.assert_allclose(np.asarray(cls_prob).sum(-1), 1.0, atol=1e-3)
+
+
+def test_mask_train_graph():
+    cfg = fpn_cfg(mask=True)
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 2, (64, 96))
+    assert "mask_head" in params
+    imgs, im_info, gtb, gtc, gtv, gm = batch(masks=True)
+
+    tot, aux = jax.jit(lambda p, k: model.apply(
+        {"params": p}, imgs, im_info, gtb, gtc, gtv, k, gt_masks=gm,
+        rngs={"dropout": k}))(params, jax.random.PRNGKey(1))
+    assert np.isfinite(float(tot))
+    assert "mask_loss" in aux and np.isfinite(float(aux["mask_loss"]))
+
+    # predict_masks path
+    boxes = gtb
+    labels = gtc
+    probs = jax.jit(lambda p: model.apply(
+        {"params": p}, imgs, im_info, boxes, labels,
+        method=model.predict_masks))(params)
+    assert probs.shape == (2, 4, 28, 28)
+    p = np.asarray(probs)
+    assert (p >= 0).all() and (p <= 1).all()
+
+
+# --- mask target oracle ------------------------------------------------------
+
+def test_mask_targets_identity_roi():
+    """RoI == gt box → target is the (downsampled) gt mask."""
+    gm = np.zeros((2, 112, 112), np.float32)
+    gm[0, :, :56] = 1.0          # left half
+    gt_boxes = jnp.asarray([[10., 10., 50., 50.], [0., 0., 20., 20.]])
+    rois = jnp.asarray([[10., 10., 50., 50.]])
+    t = mask_targets_for_rois(jnp.asarray(gm), gt_boxes, rois,
+                              jnp.asarray([0]), out_size=28)
+    t = np.asarray(t[0])
+    assert t[:, :13].mean() > 0.95     # left ~half on
+    assert t[:, 15:].mean() < 0.05     # right ~half off
+
+
+def test_mask_targets_shifted_roi():
+    """RoI covering only the right half of the gt box → all zeros."""
+    gm = np.zeros((1, 112, 112), np.float32)
+    gm[0, :, :56] = 1.0
+    gt_boxes = jnp.asarray([[0., 0., 100., 100.]])
+    rois = jnp.asarray([[50., 0., 100., 100.]])   # right half
+    t = mask_targets_for_rois(jnp.asarray(gm), gt_boxes, rois,
+                              jnp.asarray([0]), out_size=28)
+    assert np.asarray(t).mean() < 0.05
+    rois2 = jnp.asarray([[0., 0., 50., 100.]])    # left half: all ones
+    t2 = mask_targets_for_rois(jnp.asarray(gm), gt_boxes, rois2,
+                               jnp.asarray([0]), out_size=28)
+    assert np.asarray(t2).mean() > 0.9
+
+
+def test_mask_targets_outside_gt_box():
+    """RoI fully outside the gt box samples nothing."""
+    gm = np.ones((1, 112, 112), np.float32)
+    gt_boxes = jnp.asarray([[0., 0., 20., 20.]])
+    rois = jnp.asarray([[60., 60., 90., 90.]])
+    t = mask_targets_for_rois(jnp.asarray(gm), gt_boxes, rois,
+                              jnp.asarray([0]), out_size=28)
+    assert np.asarray(t).sum() == 0

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""4-step alternate Faster R-CNN training (reference ``train_alternate.py``):
+
+1. train RPN from pretrained
+2. generate proposals with the trained RPN
+3. train Fast-RCNN on the cached proposals
+4. train RPN round 2 — shared conv frozen (FIXED_PARAMS_SHARED)
+5. proposals round 2
+6. train Fast-RCNN round 2 — shared conv frozen
+7. combine_model → single deployment checkpoint
+
+Runs in-process (the reference shells out per stage); each stage reuses the
+previous stage's params exactly like the reference's load_param chain.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
+                                      get_imdb, get_train_roidb,
+                                      init_or_load_params)
+from mx_rcnn_tpu.tools.test_rpn import test_rpn
+from mx_rcnn_tpu.tools.train_rcnn import train_rcnn
+from mx_rcnn_tpu.tools.train_rpn import train_rpn
+from mx_rcnn_tpu.train.checkpoint import (CheckpointManager,
+                                          denormalize_for_save)
+from mx_rcnn_tpu.utils import combine_model
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="Train Faster R-CNN alternately")
+    add_common_args(parser, train=True)
+    parser.add_argument("--rpn_epochs", type=int, default=None,
+                        help="epochs per RPN stage (default: end_epoch)")
+    parser.add_argument("--rcnn_epochs", type=int, default=None,
+                        help="epochs per RCNN stage (default: end_epoch)")
+    return parser.parse_args()
+
+
+def alternate_train(args):
+    cfg = config_from_args(args, train=True)
+    imdb = get_imdb(args, cfg)
+    roidb = get_train_roidb(imdb, cfg)
+    model = build_model(cfg)
+    params = init_or_load_params(args, cfg, model, 1)
+    rpn_ep = args.rpn_epochs or args.end_epoch
+    rcnn_ep = args.rcnn_epochs or args.end_epoch
+
+    def stage_args(end_epoch):
+        a = argparse.Namespace(**vars(args))
+        a.begin_epoch, a.end_epoch, a.prefix = 0, end_epoch, None
+        return a
+
+    logger.info("=== stage 1: train RPN ===")
+    s1 = train_rpn(stage_args(rpn_ep), cfg=cfg, params=params, roidb=roidb)
+    logger.info("=== stage 2: generate proposals ===")
+    roidb = test_rpn(args, cfg=cfg, params=jax.device_get(s1.params),
+                     imdb=imdb, roidb=roidb)
+    logger.info("=== stage 3: train RCNN on proposals ===")
+    s3 = train_rcnn(stage_args(rcnn_ep), cfg=cfg, params=params, roidb=roidb)
+    logger.info("=== stage 4: train RPN round 2 (shared conv frozen) ===")
+    s4 = train_rpn(stage_args(rpn_ep), cfg=cfg,
+                   params=jax.device_get(s3.params), roidb=roidb,
+                   frozen_shared=True)
+    logger.info("=== stage 5: proposals round 2 ===")
+    roidb = test_rpn(args, cfg=cfg, params=jax.device_get(s4.params),
+                     imdb=imdb, roidb=roidb)
+    logger.info("=== stage 6: train RCNN round 2 (shared conv frozen) ===")
+    s6 = train_rcnn(stage_args(rcnn_ep), cfg=cfg,
+                    params=jax.device_get(s4.params), roidb=roidb,
+                    frozen_shared=True)
+    logger.info("=== stage 7: combine_model ===")
+    final = combine_model(jax.device_get(s4.params), jax.device_get(s6.params))
+    mgr = CheckpointManager(args.prefix)
+    mgr.save_epoch(args.end_epoch, final, cfg, step=0)
+    logger.info("combined checkpoint saved to %s", args.prefix)
+    return final
+
+
+if __name__ == "__main__":
+    alternate_train(parse_args())
