@@ -23,6 +23,7 @@ def parse_args():
     parser.add_argument("--image", required=True)
     parser.add_argument("--out", default="",
                         help="write visualization to this path")
+    parser.set_defaults(thresh=0.5)  # visualization default (reference demo)
     return parser.parse_args()
 
 
@@ -42,17 +43,17 @@ def demo_net(args):
                  batch_valid=np.asarray([True]))
     (scores, boxes, valid), = im_detect(predictor, batch)
 
-    classes = getattr(args, "classes", None) or [
-        f"class{i}" for i in range(cfg.NUM_CLASSES)]
     from mx_rcnn_tpu.data.pascal_voc import VOC_CLASSES
 
     if cfg.NUM_CLASSES == len(VOC_CLASSES):
         classes = list(VOC_CLASSES)
+    else:
+        classes = [f"class{i}" for i in range(cfg.NUM_CLASSES)]
 
     all_dets = []
     v = np.asarray(valid, bool)
     for k in range(1, cfg.NUM_CLASSES):
-        sel = (scores[:, k] > 0.5) & v
+        sel = (scores[:, k] > args.thresh) & v
         dets = np.hstack([boxes[sel, 4 * k:4 * (k + 1)],
                           scores[sel, k][:, None]]).astype(np.float32)
         keep = nms(dets, cfg.TEST.NMS)

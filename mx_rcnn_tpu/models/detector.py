@@ -24,6 +24,7 @@ parity is statistical, not bitwise).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import flax.linen as nn
@@ -313,7 +314,10 @@ def init_params(model: FasterRCNN, cfg: Config, key, batch_size: int = 1,
         gt_classes=jnp.zeros((batch_size, g), jnp.int32),
         gt_valid=jnp.zeros((batch_size, g), bool),
     )
-    variables = model.init({"params": k1, "dropout": k2}, dummy["images"],
-                           dummy["im_info"], dummy["gt_boxes"], dummy["gt_classes"],
-                           dummy["gt_valid"], k2, **kwargs)
+    # jit the init: eager flax init dispatches the whole train graph op by
+    # op — minutes at full image scale on a tunneled device
+    init_fn = jax.jit(partial(model.init, **kwargs))
+    variables = init_fn({"params": k1, "dropout": k2}, dummy["images"],
+                        dummy["im_info"], dummy["gt_boxes"],
+                        dummy["gt_classes"], dummy["gt_valid"], k2)
     return variables["params"]

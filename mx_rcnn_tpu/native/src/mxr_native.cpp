@@ -76,6 +76,18 @@ int64_t mxr_nms(const float* dets, int64_t n, float thresh,
   return kept;
 }
 
+// Advance to the next run, skipping zero-length runs (each skipped run
+// still toggles the value — an RLE starting with count 0 means the mask
+// begins with foreground).
+static inline void rle_advance(const uint32_t* c, int64_t nc, int64_t* i,
+                               int64_t* cur, int* v, int64_t n) {
+  do {
+    ++*i;
+    *cur = (*i < nc) ? (int64_t)c[*i] : n;
+    *v ^= 1;
+  } while (*cur == 0 && *i < nc);
+}
+
 // |A n B| for two column-major RLEs (counts arrays) over n pixels.
 int64_t mxr_rle_intersect(const uint32_t* a, int64_t na, const uint32_t* b,
                           int64_t nb, int64_t n) {
@@ -83,21 +95,15 @@ int64_t mxr_rle_intersect(const uint32_t* a, int64_t na, const uint32_t* b,
   int64_t ca = na > 0 ? (int64_t)a[0] : n;
   int64_t cb = nb > 0 ? (int64_t)b[0] : n;
   int va = 0, vb = 0;
+  if (ca == 0) rle_advance(a, na, &ia, &ca, &va, n);
+  if (cb == 0) rle_advance(b, nb, &ib, &cb, &vb, n);
   while (pos < n) {
-    int64_t step = std::min(ca, cb);
-    if (step <= 0) step = 1;  // defensive: zero-length run
+    const int64_t step = std::min(ca, cb);
+    if (step <= 0) break;  // both exhausted (padding beyond counts)
     if (va && vb) inter += step;
     ca -= step; cb -= step; pos += step;
-    if (ca == 0) {
-      ++ia;
-      ca = ia < na ? (int64_t)a[ia] : n;
-      va ^= 1;
-    }
-    if (cb == 0) {
-      ++ib;
-      cb = ib < nb ? (int64_t)b[ib] : n;
-      vb ^= 1;
-    }
+    if (ca == 0) rle_advance(a, na, &ia, &ca, &va, n);
+    if (cb == 0) rle_advance(b, nb, &ib, &cb, &vb, n);
   }
   return inter;
 }

@@ -126,9 +126,12 @@ def propose_fpn(
         ms = min_size * im_scale
         scores = jnp.where((ws >= ms) & (hs >= ms), scores, -1.0)
         k = min(k_level, scores.shape[0])
-        top_scores, top_idx = jax.lax.top_k(scores, k)
+        # argsort instead of lax.top_k: the v5e compiler SIGABRTs on top_k
+        # fused into the full FPN pyramid graph (verified: top_k alone and
+        # the standalone propose compile; only the fused graph crashes)
+        top_idx = jnp.argsort(-scores)[:k]
         cand_boxes.append(boxes[top_idx])
-        cand_scores.append(top_scores)
+        cand_scores.append(scores[top_idx])
     boxes = jnp.concatenate(cand_boxes, axis=0)
     scores = jnp.concatenate(cand_scores, axis=0)
     # global score sort: each level's top-k is sorted internally but not

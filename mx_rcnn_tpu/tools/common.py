@@ -19,7 +19,7 @@ from mx_rcnn_tpu.data.pascal_voc import PascalVOC
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model, init_params
 from mx_rcnn_tpu.parallel import MeshPlan, make_mesh
-from mx_rcnn_tpu.train.checkpoint import load_params_npz, normalize_for_train
+from mx_rcnn_tpu.train.checkpoint import load_params_npz
 
 
 def add_common_args(parser: argparse.ArgumentParser, train: bool = True):

@@ -22,6 +22,9 @@ def train_rcnn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
     n_dev = plan.n_data if plan else 1
     batch_size = (getattr(args, "batch_images", None)
                   or n_dev * cfg.TRAIN.BATCH_IMAGES)
+    if plan and batch_size % n_dev:
+        raise ValueError(f"batch_images {batch_size} not divisible by "
+                         f"mesh size {n_dev}")
     if roidb is None:
         imdb = get_imdb(args, cfg)
         roidb = get_train_roidb(imdb, cfg)

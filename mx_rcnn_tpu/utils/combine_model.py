@@ -6,7 +6,7 @@ RCNN head (head_body + rcnn_out) from the RCNN stage.
 
 from __future__ import annotations
 
-RPN_KEYS = ("backbone", "rpn")
+RPN_KEYS = ("backbone", "neck", "rpn")  # neck: FPN models share it with RPN
 RCNN_KEYS = ("head_body", "rcnn_out", "mask_head")
 
 

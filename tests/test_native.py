@@ -39,7 +39,14 @@ def test_native_nms_matches(rng):
 
 def test_native_rle_iou_matches(rng):
     masks = [(rng.rand(30, 25) > 0.6).astype(np.uint8) for _ in range(4)]
+    # leading-set-pixel masks: RLE counts start with 0 (regression for the
+    # zero-length-run desync) — plus a solid mask
+    m0 = masks[0].copy()
+    m0[0, 0] = 1
+    masks[0] = m0
+    masks[2] = np.ones((30, 25), np.uint8)
     rles = [M.encode(m) for m in masks]
+    assert rles[0]["counts"][0] == 0  # the regression precondition
     crowd = np.asarray([False, True], bool)
     got = native.rle_iou(rles[:2], rles[2:], crowd)
     want = M.rle_iou(rles[:2], rles[2:], crowd)

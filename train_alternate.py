@@ -27,8 +27,7 @@ from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
 from mx_rcnn_tpu.tools.test_rpn import test_rpn
 from mx_rcnn_tpu.tools.train_rcnn import train_rcnn
 from mx_rcnn_tpu.tools.train_rpn import train_rpn
-from mx_rcnn_tpu.train.checkpoint import (CheckpointManager,
-                                          denormalize_for_save)
+from mx_rcnn_tpu.train.checkpoint import CheckpointManager
 from mx_rcnn_tpu.utils import combine_model
 
 
