@@ -127,3 +127,42 @@ class COCODataset(IMDB):
         logger.info("COCO %s AP: %.4f (AP50 %.4f AP75 %.4f)", iou_type,
                     stats["AP"], stats["AP50"], stats["AP75"])
         return stats
+
+    def segmentations_to_coco(self, detections, masks) -> list:
+        """(all_boxes, all_masks) → COCO segm results records; masks are
+        full-image RLE dicts aligned row-for-row with all_boxes."""
+        from mx_rcnn_tpu.eval.mask_rle import area
+
+        results = []
+        for k in range(1, self.num_classes):
+            cat_id = self._cls_to_cat[k]
+            for i, dets in enumerate(detections[k]):
+                if dets is None or len(dets) == 0:
+                    continue
+                img_id = self._images[i]["id"]
+                row_masks = masks[k][i] or []
+                for di, d in enumerate(np.asarray(dets, np.float64)):
+                    if di >= len(row_masks) or row_masks[di] is None:
+                        continue
+                    rle = row_masks[di]
+                    results.append({
+                        "image_id": int(img_id), "category_id": int(cat_id),
+                        "segmentation": rle, "area": float(area(rle)),
+                        "score": float(d[4]),
+                    })
+        return results
+
+    def evaluate_sds(self, detections, masks) -> dict:
+        """Joint box + mask scoring (Mask R-CNN eval; name from the
+        SDS/'simultaneous detection and segmentation' lineage).  Returns
+        {'bbox': {...}, 'segm': {...}}."""
+        from mx_rcnn_tpu.eval.coco_eval import COCOEval
+
+        out = {"bbox": self.evaluate_detections(detections)}
+        segm_results = self.segmentations_to_coco(detections, masks)
+        ev = COCOEval(self.ann_file, segm_results, iou_type="segm")
+        stats = ev.evaluate()
+        logger.info("COCO segm AP: %.4f (AP50 %.4f AP75 %.4f)",
+                    stats["AP"], stats["AP50"], stats["AP75"])
+        out["segm"] = stats
+        return out

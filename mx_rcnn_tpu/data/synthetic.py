@@ -68,6 +68,12 @@ class SyntheticDataset(IMDB):
         self._roidb = roidb
         return roidb
 
+    def evaluate_sds(self, detections, masks) -> dict:
+        """Box AP only — synthetic gt has rectangular instances, so segm
+        scoring adds nothing; masks are exercised by the coco path."""
+        del masks
+        return {"bbox": self.evaluate_detections(detections)}
+
     def evaluate_detections(self, detections) -> dict:
         """Greedy-match AP at IoU 0.5 via the VOC scorer (classes are
         synthetic but the metric math is the real one)."""

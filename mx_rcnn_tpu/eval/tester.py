@@ -38,12 +38,46 @@ class Predictor:
         self._predict_rpn = jax.jit(
             lambda p, images, im_info: model.apply(
                 {"params": p}, images, im_info, method=model.predict_rpn))
+        self._predict_masks = None
+        if cfg.network.HAS_MASK:
+            self._predict_masks = jax.jit(
+                lambda p, images, im_info, boxes, labels: model.apply(
+                    {"params": p}, images, im_info, boxes, labels,
+                    method=model.predict_masks))
 
     def predict(self, images, im_info):
         return self._predict(self.params, images, im_info)
 
     def predict_rpn(self, images, im_info):
         return self._predict_rpn(self.params, images, im_info)
+
+    def predict_masks(self, images, im_info, boxes, labels):
+        """boxes in the SCALED frame; → (B, R, 28, 28) probabilities."""
+        assert self._predict_masks is not None, "model has no mask head"
+        return self._predict_masks(self.params, images, im_info, boxes, labels)
+
+
+def paste_mask(prob: np.ndarray, box: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Paste one (M, M) mask probability map into a (h, w) binary mask at
+    ``box`` (original-frame [x1,y1,x2,y2]) — the standard Mask R-CNN
+    inference paste (resize to box, threshold 0.5)."""
+    import cv2
+
+    x1 = int(np.floor(box[0]))
+    y1 = int(np.floor(box[1]))
+    x2 = int(np.ceil(box[2]))
+    y2 = int(np.ceil(box[3]))
+    bw = max(x2 - x1 + 1, 1)
+    bh = max(y2 - y1 + 1, 1)
+    resized = cv2.resize(prob.astype(np.float32), (bw, bh),
+                         interpolation=cv2.INTER_LINEAR)
+    out = np.zeros((h, w), np.uint8)
+    ox1, oy1 = max(x1, 0), max(y1, 0)
+    ox2, oy2 = min(x2 + 1, w), min(y2 + 1, h)
+    if ox2 > ox1 and oy2 > oy1:
+        out[oy1:oy2, ox1:ox2] = (
+            resized[oy1 - y1:oy2 - y1, ox1 - x1:ox2 - x1] >= 0.5)
+    return out
 
 
 def im_detect(predictor: Predictor, batch: dict):
@@ -79,10 +113,16 @@ def im_detect(predictor: Predictor, batch: dict):
 def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
               max_per_image: Optional[int] = None,
               thresh: Optional[float] = None,
-              vis: bool = False) -> dict:
+              vis: bool = False,
+              with_masks: bool = False) -> dict:
     """Dataset eval loop (reference ``pred_eval``): all_boxes[cls][image] =
     (N, 5) [x1,y1,x2,y2,score]; per-class score threshold + NMS; global
-    per-image cap; then ``imdb.evaluate_detections``."""
+    per-image cap; then ``imdb.evaluate_detections``.
+
+    ``with_masks`` (Mask R-CNN configs): runs the mask branch on the final
+    detections, pastes 28×28 probabilities into full-image RLEs, and scores
+    segm alongside bbox (``imdb.evaluate_sds``).
+    """
     cfg = predictor.cfg
     if max_per_image is None:
         max_per_image = cfg.TEST.MAX_PER_IMAGE
@@ -90,9 +130,13 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
         thresh = cfg.TEST.THRESH
     num_classes = imdb.num_classes
     num_images = imdb.num_images
+    with_masks = with_masks and cfg.network.HAS_MASK
 
     all_boxes: List[List] = [[None for _ in range(num_images)]
                              for _ in range(num_classes)]
+    all_masks: Optional[List[List]] = (
+        [[None for _ in range(num_images)] for _ in range(num_classes)]
+        if with_masks else None)
     t0 = time.time()
     done = 0
     for batch in test_loader:
@@ -119,10 +163,61 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
                         keep = all_boxes[k][i][:, 4] >= th
                         all_boxes[k][i] = all_boxes[k][i][keep]
             done += 1
+        if with_masks:
+            _mask_pass(predictor, batch, dets, all_boxes, all_masks,
+                       test_loader.roidb, max_per_image, num_classes)
         if done % 100 < len(dets):
             logger.info("im_detect: %d/%d  %.3fs/im", done, num_images,
                         (time.time() - t0) / max(done, 1))
+    if with_masks:
+        if hasattr(imdb, "evaluate_sds"):
+            return imdb.evaluate_sds(all_boxes, all_masks)
+        logger.warning("%s has no segm evaluation; scoring boxes only",
+                       type(imdb).__name__)
     return imdb.evaluate_detections(all_boxes)
+
+
+def _mask_pass(predictor, batch, dets, all_boxes, all_masks, roidb,
+               max_per_image, num_classes):
+    """Run the mask branch for one batch's FINAL detections and fill
+    ``all_masks`` with full-image RLEs aligned row-for-row with
+    ``all_boxes``."""
+    from mx_rcnn_tpu.eval.mask_rle import encode
+
+    im_info = np.asarray(batch["im_info"])
+    indices = batch["indices"]
+    B = batch["images"].shape[0]  # full (padded) batch; dets covers valid rows
+    # static chunk size for the jitted mask forward; uncapped eval
+    # (max_per_image == 0) and score-tie overflows are handled by chunking
+    R = max_per_image if max_per_image > 0 else 100
+
+    # per-image queues of every final detection row (no silent drops; ties
+    # and uncapped eval can exceed R — drained in extra passes)
+    queues = [[] for _ in range(B)]  # entries: (k, i, det_row)
+    for b in range(len(dets)):
+        i = int(indices[b])
+        for k in range(1, num_classes):
+            for di in range(len(all_boxes[k][i])):
+                queues[b].append((k, i, di))
+    while any(queues):
+        mboxes = np.zeros((B, R, 4), np.float32)
+        mlabels = np.zeros((B, R), np.int32)
+        taken = [[] for _ in range(B)]
+        for b in range(B):
+            taken[b] = queues[b][:R]
+            queues[b] = queues[b][R:]
+            for r, (k, i, di) in enumerate(taken[b]):
+                mboxes[b, r] = all_boxes[k][i][di][:4] * im_info[b, 2]
+                mlabels[b, r] = k
+        probs = jax.device_get(predictor.predict_masks(
+            batch["images"], batch["im_info"], mboxes, mlabels))
+        for b in range(B):
+            for r, (k, i, di) in enumerate(taken[b]):
+                if all_masks[k][i] is None:
+                    all_masks[k][i] = [None] * len(all_boxes[k][i])
+                h, w = roidb[i]["height"], roidb[i]["width"]
+                full = paste_mask(probs[b, r], all_boxes[k][i][di][:4], h, w)
+                all_masks[k][i][di] = encode(full)
 
 
 def generate_proposals(predictor: Predictor, test_loader: TestLoader,

@@ -45,6 +45,7 @@ def fit(cfg: Config, model, params, train_loader,
         seed: int = 0,
         frequent: int = 20,
         resume: bool = False,
+        profile_dir: Optional[str] = None,
         fixed_prefixes=None) -> TrainState:
     """Train ``model`` from ``params`` over ``train_loader`` epochs.
 
@@ -54,6 +55,10 @@ def fit(cfg: Config, model, params, train_loader,
 
     ``resume=True`` (reference ``--resume``) restores params + optimizer
     state + step from ``prefix`` at ``begin_epoch``.
+
+    ``profile_dir``: capture an XProf/perfetto device trace of steps 3–8 of
+    the first epoch (the reference has no profiling subsystem — SURVEY §5
+    calls this the free win; view with xprof/tensorboard).
     """
     steps_per_epoch = train_loader.steps_per_epoch
     state, tx = create_train_state(cfg, params, steps_per_epoch,
@@ -87,11 +92,21 @@ def fit(cfg: Config, model, params, train_loader,
     bank = MetricBank()
     key = jax.random.PRNGKey(seed)
 
+    profiling = False
     for epoch in range(begin_epoch, end_epoch):
         bank.reset()
         speedo.reset()
         pending = None
         for i, batch in enumerate(train_loader):
+            if profile_dir and epoch == begin_epoch:
+                if i == min(3, steps_per_epoch - 1):
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                elif profiling and i == 8:
+                    jax.block_until_ready(pending)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    logger.info("wrote device trace to %s", profile_dir)
             key, sub = jax.random.split(key)
             if plan is not None:
                 batch = shard_batch(plan, batch)
@@ -104,6 +119,11 @@ def fit(cfg: Config, model, params, train_loader,
                 bank.update(jax.device_get(pending))
                 pending = None
             speedo(epoch, i, bank.format())
+        if profiling:  # epoch shorter than the stop step: close the trace
+            jax.block_until_ready(pending)
+            jax.profiler.stop_trace()
+            profiling = False
+            logger.info("wrote device trace to %s", profile_dir)
         if pending is not None:
             bank.update(jax.device_get(pending))
         logger.info("Epoch[%d] Train-%s", epoch,

@@ -30,10 +30,19 @@ def test_rcnn(args):
     params = load_eval_params(args, cfg, model)
     predictor = Predictor(model, params, cfg)
     loader = TestLoader(roidb, cfg, batch_size=args.batch_images)
-    stats = pred_eval(predictor, loader, imdb, thresh=args.thresh)
-    logger.info("evaluation done: %s",
-                {k: round(float(v), 4) for k, v in stats.items()
-                 if isinstance(v, (int, float))})
+    stats = pred_eval(predictor, loader, imdb, thresh=args.thresh,
+                      with_masks=cfg.network.HAS_MASK)
+
+    def flat(d, prefix=""):
+        out = {}
+        for k, v in d.items():
+            if isinstance(v, dict):
+                out.update(flat(v, prefix + k + "/"))
+            elif isinstance(v, (int, float)):
+                out[prefix + k] = round(float(v), 4)
+        return out
+
+    logger.info("evaluation done: %s", flat(stats))
     return stats
 
 

@@ -1,0 +1,100 @@
+"""Mask R-CNN eval path: paste_mask oracle, COCO segm results assembly,
+and the full pred_eval(with_masks=True) loop on a tiny mask model."""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import SyntheticDataset, TestLoader
+from mx_rcnn_tpu.eval import Predictor, pred_eval
+from mx_rcnn_tpu.eval.mask_rle import decode, encode
+from mx_rcnn_tpu.eval.tester import paste_mask
+from mx_rcnn_tpu.models import build_model, init_params
+
+
+def test_paste_mask_geometry():
+    prob = np.ones((28, 28), np.float32)
+    out = paste_mask(prob, np.asarray([10, 20, 29, 49]), h=60, w=50)
+    assert out.shape == (60, 50)
+    assert out[20:50, 10:30].all()
+    assert out.sum() == 30 * 20
+    # clipped at borders
+    out2 = paste_mask(prob, np.asarray([-5, -5, 9, 9]), h=20, w=20)
+    assert out2[:10, :10].all() and out2.sum() == 100
+    # half-on mask: left half above threshold only
+    half = np.zeros((28, 28), np.float32)
+    half[:, :14] = 1.0
+    out3 = paste_mask(half, np.asarray([0, 0, 27, 27]), h=28, w=28)
+    assert out3[:, :12].all() and not out3[:, 16:].any()
+
+
+@pytest.fixture
+def coco_ds(tmp_path):
+    from mx_rcnn_tpu.data.coco_dataset import COCODataset
+
+    root = tmp_path / "coco"
+    (root / "annotations").mkdir(parents=True)
+    (root / "val2017").mkdir()
+    gt_mask = np.zeros((100, 100), np.uint8)
+    gt_mask[10:50, 10:50] = 1
+    ann = {
+        "images": [{"id": 1, "file_name": "a.jpg", "height": 100, "width": 100}],
+        "categories": [{"id": 5, "name": "cat"}],
+        "annotations": [{
+            "id": 1, "image_id": 1, "category_id": 5,
+            "bbox": [10, 10, 40, 40], "area": 1600, "iscrowd": 0,
+            "segmentation": {"size": [100, 100],
+                             "counts": encode(gt_mask)["counts"]},
+        }],
+    }
+    (root / "annotations" / "instances_val2017.json").write_text(
+        json.dumps(ann))
+    return COCODataset("val2017", str(root), str(root)), gt_mask
+
+
+def test_evaluate_sds_perfect_mask(coco_ds):
+    ds, gt_mask = coco_ds
+    all_boxes = [None, [np.asarray([[10, 10, 49, 49, 0.9]], np.float32)]]
+    all_masks = [None, [[encode(gt_mask)]]]
+    stats = ds.evaluate_sds(all_boxes, all_masks)
+    assert np.isclose(stats["bbox"]["AP"], 1.0)
+    assert np.isclose(stats["segm"]["AP"], 1.0)
+
+
+def test_evaluate_sds_wrong_mask(coco_ds):
+    ds, gt_mask = coco_ds
+    wrong = np.zeros_like(gt_mask)
+    wrong[60:90, 60:90] = 1
+    all_boxes = [None, [np.asarray([[10, 10, 49, 49, 0.9]], np.float32)]]
+    all_masks = [None, [[encode(wrong)]]]
+    stats = ds.evaluate_sds(all_boxes, all_masks)
+    assert np.isclose(stats["bbox"]["AP"], 1.0)
+    assert stats["segm"]["AP"] == 0.0
+
+
+def test_pred_eval_with_masks_smoke():
+    cfg = generate_config(
+        "resnet101_fpn_mask", "PascalVOC",
+        TEST__RPN_PRE_NMS_TOP_N=250, TEST__RPN_POST_NMS_TOP_N=32,
+        TEST__MAX_PER_IMAGE=8,
+    )
+    net = dataclasses.replace(cfg.network, NETWORK="resnet50",
+                              FPN_ANCHOR_SCALES=(4,),
+                              PIXEL_STDS=(127.0, 127.0, 127.0))
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=4)
+    cfg = cfg.replace(network=net, tpu=tpu)
+    ds = SyntheticDataset(num_images=2, num_classes=cfg.NUM_CLASSES,
+                          height=64, width=96)
+    roidb = ds.gt_roidb()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    pred = Predictor(model, params, cfg)
+    stats = pred_eval(pred, TestLoader(roidb, cfg, batch_size=1), ds,
+                      with_masks=True)
+    # synthetic evaluate_sds returns box stats only, but the mask branch
+    # (predict_masks + paste + RLE) must have executed without error
+    assert "bbox" in stats and "mAP" in stats["bbox"]

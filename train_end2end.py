@@ -31,6 +31,8 @@ from mx_rcnn_tpu.train import fit
 def parse_args():
     parser = argparse.ArgumentParser(description="Train Faster R-CNN end2end")
     add_common_args(parser, train=True)
+    parser.add_argument("--profile", default="",
+                        help="write an XProf device trace of early steps here")
     return parser.parse_args()
 
 
@@ -59,6 +61,7 @@ def train_net(args):
                 begin_epoch=args.begin_epoch, end_epoch=args.end_epoch,
                 plan=plan, prefix=args.prefix, graph="end2end",
                 frequent=args.frequent, resume=args.resume,
+                profile_dir=getattr(args, "profile", "") or None,
                 fixed_prefixes=cfg.network.FIXED_PARAMS)
     return state
 
