@@ -65,12 +65,10 @@ def demo_net(args):
     if args.out:
         import cv2
 
+        from mx_rcnn_tpu.eval.tester import draw_detections
+
         img = cv2.cvtColor(orig, cv2.COLOR_RGB2BGR)
-        for name, d in all_dets:
-            x1, y1, x2, y2 = (int(round(c)) for c in d[:4])
-            cv2.rectangle(img, (x1, y1), (x2, y2), (0, 220, 0), 2)
-            cv2.putText(img, f"{name} {d[4]:.2f}", (x1, max(y1 - 4, 10)),
-                        cv2.FONT_HERSHEY_SIMPLEX, 0.5, (0, 220, 0), 1)
+        draw_detections(img, all_dets)
         cv2.imwrite(args.out, img)
         logger.info("wrote %s (%d detections)", args.out, len(all_dets))
     return all_dets

@@ -1,0 +1,77 @@
+"""Roidb-wide bbox regression target statistics (reference
+``rcnn/processing/bbox_regression.py``: ``add_bbox_regression_targets`` /
+``compute_bbox_regression_targets``).
+
+With ``BBOX_NORMALIZATION_PRECOMPUTED`` (the default, here and in the
+reference) training uses the fixed ``BBOX_MEANS``/``BBOX_STDS``; this module
+provides the legacy alternative — measure the per-class delta statistics
+over a proposal roidb (the ROIIter / Fast-RCNN path) and return the
+(means, stds) to feed into the config.  The per-RoI target assignment and
+the class-specific 4·K expansion live in ``ops/sample_rois.py`` (in-graph).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def compute_bbox_regression_targets(rois: np.ndarray, gt_boxes: np.ndarray,
+                                    gt_classes: np.ndarray,
+                                    fg_thresh: float = 0.5) -> np.ndarray:
+    """(R, 5) [cls, dx, dy, dw, dh] for rois vs their argmax gt (rows with
+    max IoU < fg_thresh get class 0 and zero targets)."""
+    from mx_rcnn_tpu.native import bbox_overlaps
+
+    out = np.zeros((len(rois), 5), np.float32)
+    if len(rois) == 0 or len(gt_boxes) == 0:
+        return out
+    ov = bbox_overlaps(rois.astype(np.float32), gt_boxes.astype(np.float32))
+    max_ov = ov.max(axis=1)
+    argmax = ov.argmax(axis=1)
+    fg = max_ov >= fg_thresh
+    ex, gt = rois[fg], gt_boxes[argmax[fg]]
+
+    ex_w = ex[:, 2] - ex[:, 0] + 1.0
+    ex_h = ex[:, 3] - ex[:, 1] + 1.0
+    ex_cx = ex[:, 0] + 0.5 * (ex_w - 1.0)
+    ex_cy = ex[:, 1] + 0.5 * (ex_h - 1.0)
+    gt_w = gt[:, 2] - gt[:, 0] + 1.0
+    gt_h = gt[:, 3] - gt[:, 1] + 1.0
+    gt_cx = gt[:, 0] + 0.5 * (gt_w - 1.0)
+    gt_cy = gt[:, 1] + 0.5 * (gt_h - 1.0)
+
+    out[fg, 0] = gt_classes[argmax[fg]]
+    out[fg, 1] = (gt_cx - ex_cx) / (ex_w + 1e-14)
+    out[fg, 2] = (gt_cy - ex_cy) / (ex_h + 1e-14)
+    out[fg, 3] = np.log(gt_w / (ex_w + 1e-14))
+    out[fg, 4] = np.log(gt_h / (ex_h + 1e-14))
+    return out
+
+
+def add_bbox_regression_targets(roidb: list, num_classes: int,
+                                fg_thresh: float = 0.5
+                                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Attach ``bbox_targets`` to each record and return (means, stds)
+    measured class-agnostically over all fg targets (the reference averages
+    its per-class stats when PRECOMPUTED is off; the fixed defaults
+    (0, 0.1/0.2) approximate these — this recovers the measured version)."""
+    sums = np.zeros(4)
+    sq = np.zeros(4)
+    count = 0
+    for rec in roidb:
+        props = rec.get("proposals", rec["boxes"])
+        t = compute_bbox_regression_targets(
+            np.asarray(props, np.float32), rec["boxes"], rec["gt_classes"],
+            fg_thresh)
+        rec["bbox_targets"] = t
+        fg = t[:, 0] > 0
+        sums += t[fg, 1:].sum(axis=0)
+        sq += (t[fg, 1:] ** 2).sum(axis=0)
+        count += int(fg.sum())
+    if count == 0:
+        return np.zeros(4), np.ones(4)
+    means = sums / count
+    stds = np.sqrt(np.maximum(sq / count - means ** 2, 1e-12))
+    return means, stds

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import os
 import xml.etree.ElementTree as ET
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -112,11 +112,35 @@ class PascalVOC(IMDB):
         return roidb
 
     # -- evaluation ----------------------------------------------------------
-    def evaluate_detections(self, detections, use_07_metric: bool = True) -> dict:
+    def write_results(self, detections, out_dir: str) -> None:
+        """Official per-class result files (reference ``write_pascal_results``:
+        ``comp4_det_<set>_<cls>.txt`` rows ``id score x1 y1 x2 y2``,
+        1-indexed pixels)."""
+        os.makedirs(out_dir, exist_ok=True)
+        for k, cls in enumerate(self.classes):
+            if cls == "__background__":
+                continue
+            path = os.path.join(out_dir,
+                                f"comp4_det_{self.image_set}_{cls}.txt")
+            with open(path, "w") as f:
+                for i, dets in enumerate(detections[k]):
+                    if dets is None or len(dets) == 0:
+                        continue
+                    _, idx = self._index[i]
+                    for d in dets:
+                        f.write(f"{idx} {d[4]:.3f} {d[0] + 1:.1f} "
+                                f"{d[1] + 1:.1f} {d[2] + 1:.1f} {d[3] + 1:.1f}\n")
+        logger.info("wrote VOC result files to %s", out_dir)
+
+    def evaluate_detections(self, detections, use_07_metric: bool = True,
+                            out_dir: Optional[str] = None) -> dict:
         """detections: list over classes (bg included, index 0 unused) of
         per-image (N, 5) [x1,y1,x2,y2,score] arrays — the reference
         ``all_boxes`` layout from pred_eval.  Returns {class: AP, 'mAP': m}."""
         from mx_rcnn_tpu.eval.voc_eval import voc_eval
+
+        if out_dir:
+            self.write_results(detections, out_dir)
 
         # gt in voc_eval's expected form, one recs dict per image index
         recs = {}

@@ -38,23 +38,36 @@ class Predictor:
         self._predict_rpn = jax.jit(
             lambda p, images, im_info: model.apply(
                 {"params": p}, images, im_info, method=model.predict_rpn))
-        self._predict_masks = None
+        self._masks_from_feats = None
+        self._feats = None  # pyramid cache: set by predict(), same batch only
         if cfg.network.HAS_MASK:
-            self._predict_masks = jax.jit(
-                lambda p, images, im_info, boxes, labels: model.apply(
-                    {"params": p}, images, im_info, boxes, labels,
-                    method=model.predict_masks))
+            self._predict_wf = jax.jit(
+                lambda p, images, im_info: model.apply(
+                    {"params": p}, images, im_info,
+                    method=model.predict_with_feats))
+            self._masks_from_feats = jax.jit(
+                lambda p, feats, boxes, labels: model.apply(
+                    {"params": p}, feats, boxes, labels,
+                    method=model.masks_from_feats))
 
     def predict(self, images, im_info):
+        if self._masks_from_feats is not None:
+            out, feats = self._predict_wf(self.params, images, im_info)
+            self._feats = feats  # reused by predict_masks for this batch
+            return out
         return self._predict(self.params, images, im_info)
 
     def predict_rpn(self, images, im_info):
         return self._predict_rpn(self.params, images, im_info)
 
     def predict_masks(self, images, im_info, boxes, labels):
-        """boxes in the SCALED frame; → (B, R, 28, 28) probabilities."""
-        assert self._predict_masks is not None, "model has no mask head"
-        return self._predict_masks(self.params, images, im_info, boxes, labels)
+        """boxes in the SCALED frame; → (B, R, 28, 28) probabilities.
+        Reuses the pyramid features cached by the immediately preceding
+        ``predict`` on the same batch (no second backbone forward)."""
+        del images, im_info
+        assert self._masks_from_feats is not None, "model has no mask head"
+        assert self._feats is not None, "call predict() on this batch first"
+        return self._masks_from_feats(self.params, self._feats, boxes, labels)
 
 
 def paste_mask(prob: np.ndarray, box: np.ndarray, h: int, w: int) -> np.ndarray:
@@ -131,6 +144,10 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
     num_classes = imdb.num_classes
     num_images = imdb.num_images
     with_masks = with_masks and cfg.network.HAS_MASK
+    if with_masks and not hasattr(imdb, "evaluate_sds"):
+        logger.warning("%s has no segm evaluation; scoring boxes only",
+                       type(imdb).__name__)
+        with_masks = False
 
     all_boxes: List[List] = [[None for _ in range(num_images)]
                              for _ in range(num_classes)]
@@ -162,6 +179,16 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
                     for k in range(1, num_classes):
                         keep = all_boxes[k][i][:, 4] >= th
                         all_boxes[k][i] = all_boxes[k][i][keep]
+            if vis:
+                import os
+
+                vis_dir = "vis"
+                os.makedirs(vis_dir, exist_ok=True)
+                vis_all_detection(
+                    test_loader.roidb[i],
+                    [all_boxes[k][i] if k else None
+                     for k in range(num_classes)],
+                    imdb.classes, os.path.join(vis_dir, f"{i:06d}.jpg"))
             done += 1
         if with_masks:
             _mask_pass(predictor, batch, dets, all_boxes, all_masks,
@@ -170,11 +197,38 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
             logger.info("im_detect: %d/%d  %.3fs/im", done, num_images,
                         (time.time() - t0) / max(done, 1))
     if with_masks:
-        if hasattr(imdb, "evaluate_sds"):
-            return imdb.evaluate_sds(all_boxes, all_masks)
-        logger.warning("%s has no segm evaluation; scoring boxes only",
-                       type(imdb).__name__)
+        return imdb.evaluate_sds(all_boxes, all_masks)
     return imdb.evaluate_detections(all_boxes)
+
+
+def draw_detections(img, labeled_dets) -> None:
+    """Draw (label, (5,) det) pairs onto a BGR image in place — the one
+    drawing routine shared by demo.py and vis_all_detection."""
+    import cv2
+
+    for name, d in labeled_dets:
+        x1, y1, x2, y2 = (int(round(c)) for c in d[:4])
+        cv2.rectangle(img, (x1, y1), (x2, y2), (0, 220, 0), 2)
+        cv2.putText(img, f"{name} {d[4]:.2f}", (x1, max(y1 - 4, 10)),
+                    cv2.FONT_HERSHEY_SIMPLEX, 0.5, (0, 220, 0), 1)
+
+
+def vis_all_detection(rec: dict, dets_per_class, class_names,
+                      out_path: str, thresh: float = 0.3) -> None:
+    """Draw one image's post-NMS detections (reference
+    ``vis_all_detection``, matplotlib → cv2 here) and write to disk."""
+    import cv2
+
+    if "image_array" in rec:
+        img = rec["image_array"][:, :, ::-1].copy()
+    else:
+        img = cv2.imread(rec["image"], cv2.IMREAD_COLOR)
+    labeled = [(class_names[k], d)
+               for k, dets in enumerate(dets_per_class)
+               if k and dets is not None
+               for d in dets if d[4] >= thresh]
+    draw_detections(img, labeled)
+    cv2.imwrite(out_path, img)
 
 
 def _mask_pass(predictor, batch, dets, all_boxes, all_masks, roidb,

@@ -75,9 +75,10 @@ class FasterRCNN(nn.Module):
     def _rcnn_head(self, feat: jnp.ndarray, rois: jnp.ndarray, deterministic: bool = True):
         """feat: (B, Hf, Wf, C); rois: (B, R, 4) image coords → (B, R, K), (B, R, 4K)."""
         scale = 1.0 / self.cfg.network.RCNN_FEAT_STRIDE
+        sr = self.cfg.tpu.ROI_SAMPLING_RATIO
         pooled = jax.vmap(
             lambda f, r: roi_align(f.astype(self._dtype), r, spatial_scale=scale,
-                                   pooled_size=self._pooled, sampling_ratio=2)
+                                   pooled_size=self._pooled, sampling_ratio=sr)
         )(feat, rois)  # (B, R, P, P, C)
         if isinstance(self.head_body, VGGFC):
             emb = self.head_body(pooled, deterministic=deterministic)

@@ -145,7 +145,7 @@ class FPNFasterRCNN(nn.Module):
             scale = 1.0 / self._strides[li]
             p = jax.vmap(lambda f, r, s=scale: roi_align(
                 f.astype(self._dtype), r, spatial_scale=s, pooled_size=pooled,
-                sampling_ratio=2))(feats[li], rois)
+                sampling_ratio=self.cfg.tpu.ROI_SAMPLING_RATIO))(feats[li], rois)
             sel = (lvl == li).astype(p.dtype)[..., None, None, None]
             acc = p * sel if acc is None else acc + p * sel
         return acc
@@ -252,6 +252,12 @@ class FPNFasterRCNN(nn.Module):
     # ---- test graph --------------------------------------------------------
 
     def predict(self, images, im_info):
+        out, _ = self.predict_with_feats(images, im_info)
+        return out
+
+    def predict_with_feats(self, images, im_info):
+        """predict + the pyramid features, so the mask branch can reuse them
+        (eval runs mask chunks per batch without re-running the backbone)."""
         cfg = self.cfg
         te = cfg.TEST
         feats = self._pyramid(images)
@@ -269,18 +275,23 @@ class FPNFasterRCNN(nn.Module):
         )(tuple(level_scores), tuple(level_deltas), im_info)
         cls_logits, bbox_deltas = self._box_head(feats, rois)
         cls_prob = jax.nn.softmax(cls_logits, axis=-1)
-        return rois, roi_valid, cls_prob, bbox_deltas, roi_scores
+        return (rois, roi_valid, cls_prob, bbox_deltas, roi_scores), feats
 
-    def predict_masks(self, images, im_info, boxes, labels):
-        """Mask branch on final detection boxes (B, R, 4) + labels (B, R) →
-        (B, R, 28, 28) sigmoid probabilities."""
-        feats = self._pyramid(images)
+    def masks_from_feats(self, feats, boxes, labels):
+        """Mask branch over precomputed pyramid features: (B, R, 4) boxes +
+        (B, R) labels → (B, R, 28, 28) sigmoid probabilities."""
         pooled14 = self._pool_levels(feats, boxes, pooled=14)
         mask_logits = self.mask_head(pooled14)
         sel = jax.nn.one_hot(labels, self.cfg.NUM_CLASSES,
                              dtype=mask_logits.dtype)
         logit = jnp.einsum("brhwk,brk->brhw", mask_logits, sel)
         return jax.nn.sigmoid(logit)
+
+    def predict_masks(self, images, im_info, boxes, labels):
+        """Mask branch from raw images (standalone use; eval prefers
+        predict_with_feats + masks_from_feats)."""
+        del im_info
+        return self.masks_from_feats(self._pyramid(images), boxes, labels)
 
     def predict_rpn(self, images, im_info):
         te = self.cfg.TEST

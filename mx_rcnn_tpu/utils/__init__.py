@@ -3,3 +3,4 @@ combine_model).  Load/save live in ``train/checkpoint.py`` (orbax + npz);
 ``combine_model`` merges alternate-training stage params."""
 
 from mx_rcnn_tpu.utils.combine_model import combine_model
+from mx_rcnn_tpu.utils.load_data import load_proposals, merge_roidb

@@ -1,0 +1,40 @@
+"""Proposal roidb assembly (reference ``rcnn/utils/load_data.py``:
+``load_proposal_roidb`` / ``merge_roidb``): attach cached RPN proposals
+(the .pkl written by ``tools/test_rpn``) to a gt roidb for ROIIter
+training, and concatenate roidbs across image sets.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List
+
+import numpy as np
+
+from mx_rcnn_tpu.logger import logger
+
+
+def load_proposals(roidb: list, pkl_path: str) -> list:
+    """Attach per-image proposals from a test_rpn cache (aligned by index)."""
+    with open(pkl_path, "rb") as f:
+        proposals = pickle.load(f)
+    if len(proposals) != len(roidb):
+        raise ValueError(f"proposal cache has {len(proposals)} entries for "
+                         f"{len(roidb)} roidb records")
+    n = 0
+    for rec, props in zip(roidb, proposals):
+        rec["proposals"] = (np.asarray(props, np.float32)
+                            if props is not None else np.zeros((0, 4), np.float32))
+        n += len(rec["proposals"])
+    logger.info("attached %d proposals from %s", n, pkl_path)
+    return roidb
+
+
+def merge_roidb(roidbs: List[list]) -> list:
+    """Concatenate roidbs (reference ``merge_roidb`` — multi-image-set
+    training, e.g. VOC07+12; PascalVOC already handles '+' sets natively,
+    this covers arbitrary combinations)."""
+    out: list = []
+    for r in roidbs:
+        out.extend(r)
+    return out

@@ -31,7 +31,7 @@ def test_rcnn(args):
     predictor = Predictor(model, params, cfg)
     loader = TestLoader(roidb, cfg, batch_size=args.batch_images)
     stats = pred_eval(predictor, loader, imdb, thresh=args.thresh,
-                      with_masks=cfg.network.HAS_MASK)
+                      vis=args.vis, with_masks=cfg.network.HAS_MASK)
 
     def flat(d, prefix=""):
         out = {}

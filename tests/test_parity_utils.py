@@ -1,0 +1,95 @@
+"""Parity utilities: roidb bbox-target stats, proposal cache loading,
+VOC result writeout, detection visualization."""
+
+import os
+import pickle
+
+import numpy as np
+
+from mx_rcnn_tpu.data import SyntheticDataset
+from mx_rcnn_tpu.data.bbox_stats import (add_bbox_regression_targets,
+                                         compute_bbox_regression_targets)
+from mx_rcnn_tpu.eval.tester import vis_all_detection
+from mx_rcnn_tpu.utils.load_data import load_proposals, merge_roidb
+
+
+def test_compute_bbox_targets_identity():
+    gt = np.asarray([[10, 10, 50, 50]], np.float32)
+    cls = np.asarray([3], np.int32)
+    t = compute_bbox_regression_targets(gt.copy(), gt, cls)
+    assert t[0, 0] == 3
+    np.testing.assert_allclose(t[0, 1:], 0.0, atol=1e-6)
+    # distant roi: below fg thresh -> class 0, zero target
+    far = np.asarray([[200, 200, 240, 240]], np.float32)
+    t2 = compute_bbox_regression_targets(far, gt, cls)
+    assert t2[0, 0] == 0 and np.all(t2[0, 1:] == 0)
+
+
+def test_add_bbox_regression_targets_stats():
+    ds = SyntheticDataset(num_images=8, height=120, width=160)
+    roidb = ds.gt_roidb()
+    rng = np.random.RandomState(0)
+    for rec in roidb:
+        jitter = rng.randn(*rec["boxes"].shape).astype(np.float32) * 3
+        rec["proposals"] = np.clip(rec["boxes"] + jitter, 0, 159)
+    means, stds = add_bbox_regression_targets(roidb, ds.num_classes)
+    assert means.shape == (4,) and stds.shape == (4,)
+    assert np.all(stds > 0)
+    assert np.abs(means).max() < 0.5  # small jitter -> near-zero means
+    for rec in roidb:
+        assert "bbox_targets" in rec
+        assert rec["bbox_targets"].shape[1] == 5
+
+
+def test_load_proposals_roundtrip(tmp_path):
+    ds = SyntheticDataset(num_images=3, height=100, width=100)
+    roidb = ds.gt_roidb()
+    props = [rec["boxes"] + 1.0 for rec in roidb]
+    p = str(tmp_path / "props.pkl")
+    with open(p, "wb") as f:
+        pickle.dump(props, f)
+    out = load_proposals(roidb, p)
+    np.testing.assert_allclose(out[1]["proposals"], roidb[1]["boxes"] + 1.0)
+    merged = merge_roidb([roidb, roidb])
+    assert len(merged) == 6
+
+
+def test_voc_write_results(tmp_path):
+    from mx_rcnn_tpu.data.pascal_voc import PascalVOC, VOC_CLASSES
+
+    # minimal VOCdevkit: 1 image, 1 annotation
+    devkit = tmp_path / "VOCdevkit" / "VOC2007"
+    (devkit / "ImageSets" / "Main").mkdir(parents=True)
+    (devkit / "Annotations").mkdir()
+    (devkit / "JPEGImages").mkdir()
+    (devkit / "ImageSets" / "Main" / "test.txt").write_text("000001\n")
+    (devkit / "Annotations" / "000001.xml").write_text("""
+<annotation><size><width>100</width><height>100</height></size>
+<object><name>car</name><difficult>0</difficult>
+<bndbox><xmin>11</xmin><ymin>11</ymin><xmax>51</xmax><ymax>51</ymax></bndbox>
+</object></annotation>""")
+    import cv2
+    cv2.imwrite(str(devkit / "JPEGImages" / "000001.jpg"),
+                np.zeros((100, 100, 3), np.uint8))
+
+    ds = PascalVOC("2007_test", str(tmp_path), str(tmp_path / "VOCdevkit"))
+    assert ds.num_images == 1
+    dets = [np.zeros((0, 5), np.float32) for _ in VOC_CLASSES]
+    k_car = list(VOC_CLASSES).index("car")
+    dets[k_car] = [np.asarray([[10, 10, 50, 50, 0.9]], np.float32)]
+    stats = ds.evaluate_detections(dets, out_dir=str(tmp_path / "results"))
+    assert np.isclose(stats["car"], 1.0)
+    out = (tmp_path / "results" / "comp4_det_2007_test_car.txt").read_text()
+    assert out.startswith("000001 0.900 11.0 11.0 51.0 51.0")
+
+
+def test_vis_all_detection(tmp_path):
+    ds = SyntheticDataset(num_images=1, num_classes=5, height=80, width=80)
+    rec = ds.gt_roidb()[0]
+    dets = [None] + [[np.asarray([[5, 5, 40, 40, 0.8]], np.float32)]
+                     if k == 1 else np.zeros((0, 5), np.float32)
+                     for k in range(1, 5)]
+    dets[1] = np.asarray([[5, 5, 40, 40, 0.8]], np.float32)
+    out = str(tmp_path / "vis.jpg")
+    vis_all_detection(rec, dets, ds.classes, out)
+    assert os.path.exists(out)
