@@ -172,6 +172,8 @@ class TPUConfig:
     # at-or-above the reference's integer-binned ROIPooling fidelity and
     # 1.8x faster end-to-end (4x fewer gather points).  FPN/Mask presets
     # get 2 via generate_config — Mask R-CNN paper parity for the mask head.
+    # NOTE: affects numerics; train and eval must use the same value (any
+    # consistent generate_config call does).
     ROI_SAMPLING_RATIO: int = 1
     # host→device prefetch depth
     PREFETCH: int = 2

@@ -33,20 +33,11 @@ def compute_bbox_regression_targets(rois: np.ndarray, gt_boxes: np.ndarray,
     fg = max_ov >= fg_thresh
     ex, gt = rois[fg], gt_boxes[argmax[fg]]
 
-    ex_w = ex[:, 2] - ex[:, 0] + 1.0
-    ex_h = ex[:, 3] - ex[:, 1] + 1.0
-    ex_cx = ex[:, 0] + 0.5 * (ex_w - 1.0)
-    ex_cy = ex[:, 1] + 0.5 * (ex_h - 1.0)
-    gt_w = gt[:, 2] - gt[:, 0] + 1.0
-    gt_h = gt[:, 3] - gt[:, 1] + 1.0
-    gt_cx = gt[:, 0] + 0.5 * (gt_w - 1.0)
-    gt_cy = gt[:, 1] + 0.5 * (gt_h - 1.0)
+    from mx_rcnn_tpu.ops.boxes import bbox_transform  # the canonical codec
 
     out[fg, 0] = gt_classes[argmax[fg]]
-    out[fg, 1] = (gt_cx - ex_cx) / (ex_w + 1e-14)
-    out[fg, 2] = (gt_cy - ex_cy) / (ex_h + 1e-14)
-    out[fg, 3] = np.log(gt_w / (ex_w + 1e-14))
-    out[fg, 4] = np.log(gt_h / (ex_h + 1e-14))
+    if fg.any():
+        out[fg, 1:] = np.asarray(bbox_transform(ex, gt))
     return out
 
 

@@ -62,12 +62,26 @@ class Predictor:
 
     def predict_masks(self, images, im_info, boxes, labels):
         """boxes in the SCALED frame; → (B, R, 28, 28) probabilities.
-        Reuses the pyramid features cached by the immediately preceding
-        ``predict`` on the same batch (no second backbone forward)."""
-        del images, im_info
+        Runs the full forward — correct for any batch."""
+        assert self.cfg.network.HAS_MASK, "model has no mask head"
+        del im_info
+        feats = self._pyramid(images)
+        return self._masks_from_feats(self.params, feats, boxes, labels)
+
+    def predict_masks_cached(self, boxes, labels):
+        """Mask branch over the pyramid cached by the immediately preceding
+        ``predict`` — ONLY valid for that same batch (pred_eval's pattern;
+        no image args so a mismatched call cannot typecheck silently)."""
         assert self._masks_from_feats is not None, "model has no mask head"
         assert self._feats is not None, "call predict() on this batch first"
         return self._masks_from_feats(self.params, self._feats, boxes, labels)
+
+    def _pyramid(self, images):
+        if not hasattr(self, "_pyr_fn"):
+            self._pyr_fn = jax.jit(
+                lambda p, x: self.model.apply({"params": p}, x,
+                                              method=self.model._pyramid))
+        return self._pyr_fn(self.params, images)
 
 
 def paste_mask(prob: np.ndarray, box: np.ndarray, h: int, w: int) -> np.ndarray:
@@ -263,8 +277,7 @@ def _mask_pass(predictor, batch, dets, all_boxes, all_masks, roidb,
             for r, (k, i, di) in enumerate(taken[b]):
                 mboxes[b, r] = all_boxes[k][i][di][:4] * im_info[b, 2]
                 mlabels[b, r] = k
-        probs = jax.device_get(predictor.predict_masks(
-            batch["images"], batch["im_info"], mboxes, mlabels))
+        probs = jax.device_get(predictor.predict_masks_cached(mboxes, mlabels))
         for b in range(B):
             for r, (k, i, di) in enumerate(taken[b]):
                 if all_masks[k][i] is None:

@@ -54,10 +54,16 @@ def _suppress_kernel(thresh_ref, rbox_ref, cx1_ref, cy1_ref, cx2_ref,
     out_ref[:] = (inter / union > thresh_ref[0]).astype(jnp.int8)
 
 
-def _sweep_kernel(sup_ref, valid_ref, keep_ref, removed_ref):
+def _sweep_kernel(max_out_ref, sup_ref, valid_ref, keep_ref, removed_ref,
+                  kept_ref):
     """Greedy sweep.  Mosaic forbids dynamic lane-indexed scalar access, so
     per-row state reads/writes are lane-vectorized: select-by-iota + full
-    reduce (a few vregs of VMEM traffic per row — VMEM-bandwidth cheap)."""
+    reduce (a few vregs of VMEM traffic per row — VMEM-bandwidth cheap).
+
+    Early termination: selection order is score order (sorted input), so
+    once ``max_out`` boxes are kept the remaining rows cannot appear in the
+    output — their work is predicated off (kept count in SMEM scratch).
+    """
     pid = pl.program_id(0)
     n_pad = sup_ref.shape[1]
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, n_pad), 1)
@@ -67,27 +73,34 @@ def _sweep_kernel(sup_ref, valid_ref, keep_ref, removed_ref):
     def _():
         removed_ref[:] = jnp.zeros_like(removed_ref)
         keep_ref[:] = jnp.zeros_like(keep_ref)
+        kept_ref[0] = 0
 
     def body(i0, _):
         # dynamic sublane access must be 8-aligned: load 8 rows, then
         # select each row by sublane-onehot reduction
         base = pl.multiple_of(i0 * 8, 8)
-        rows8 = sup_ref[pl.ds(base, 8), :].astype(jnp.int32)  # (8, N_pad)
 
-        def inner(j, _):
-            g = pid * _BR + i0 * 8 + j
-            onehot = iota == g
-            rm = jnp.sum(jnp.where(onehot, removed_ref[:], 0))
-            vd = jnp.sum(jnp.where(onehot, valid_ref[:], 0))
-            alive = (rm == 0) & (vd != 0)
-            keep_ref[:] = jnp.where(onehot & alive, 1, keep_ref[:])
-            row = jnp.sum(jnp.where(sub_iota == j, rows8, 0), axis=0,
-                          keepdims=True)                       # (1, N_pad)
-            removed_ref[:] = jnp.where(alive, removed_ref[:] | row,
-                                       removed_ref[:])
-            return 0
+        @pl.when(kept_ref[0] < max_out_ref[0])
+        def _():
+            rows8 = sup_ref[pl.ds(base, 8), :].astype(jnp.int32)  # (8, N_pad)
 
-        jax.lax.fori_loop(0, 8, inner, 0)
+            def inner(j, _):
+                g = pid * _BR + i0 * 8 + j
+                onehot = iota == g
+                rm = jnp.sum(jnp.where(onehot, removed_ref[:], 0))
+                vd = jnp.sum(jnp.where(onehot, valid_ref[:], 0))
+                alive = (rm == 0) & (vd != 0) & \
+                        (kept_ref[0] < max_out_ref[0])
+                keep_ref[:] = jnp.where(onehot & alive, 1, keep_ref[:])
+                row = jnp.sum(jnp.where(sub_iota == j, rows8, 0), axis=0,
+                              keepdims=True)                   # (1, N_pad)
+                removed_ref[:] = jnp.where(alive, removed_ref[:] | row,
+                                           removed_ref[:])
+                kept_ref[0] = kept_ref[0] + alive.astype(jnp.int32)
+                return 0
+
+            jax.lax.fori_loop(0, 8, inner, 0)
+
         return 0
 
     jax.lax.fori_loop(0, _BR // 8, body, 0)
@@ -152,14 +165,17 @@ def nms_pallas(boxes: jnp.ndarray, scores: jnp.ndarray, max_out: int,
         _sweep_kernel,
         grid=(n_pad // _BR,),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((_BR, n_pad), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
-        scratch_shapes=[pltpu.VMEM((1, n_pad), jnp.int32)],
-    )(sup, valid_p.astype(jnp.int32).reshape(1, n_pad))
+        scratch_shapes=[pltpu.VMEM((1, n_pad), jnp.int32),
+                        pltpu.SMEM((1,), jnp.int32)],
+    )(jnp.asarray([max_out], jnp.int32), sup,
+      valid_p.astype(jnp.int32).reshape(1, n_pad))
 
     keep_mask_full = keep[0, :n] > 0
     # kept boxes in index order == score order; compact to max_out slots
