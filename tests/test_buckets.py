@@ -1,0 +1,46 @@
+"""Mixed-orientation scale buckets: one train step function serves both
+(landscape, portrait) compiled programs — the MutableModule replacement
+(SURVEY §5 long-context row: resolution buckets instead of rebinding)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import AnchorLoader, SyntheticDataset
+from mx_rcnn_tpu.models import build_model, init_params
+from mx_rcnn_tpu.train import create_train_state, make_train_step
+from mx_rcnn_tpu.utils import merge_roidb
+
+
+def test_mixed_orientation_buckets_train():
+    cfg = generate_config(
+        "resnet50", "PascalVOC",
+        TRAIN__RPN_PRE_NMS_TOP_N=200, TRAIN__RPN_POST_NMS_TOP_N=32,
+        TRAIN__BATCH_ROIS=16,
+    )
+    cfg = cfg.replace(
+        network=dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4),
+                                    PIXEL_STDS=(127.0, 127.0, 127.0)),
+        tpu=dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=4))
+    land = SyntheticDataset(num_images=2, num_classes=5, height=64, width=96,
+                            seed=0)
+    port = SyntheticDataset(num_images=2, num_classes=5, height=96, width=64,
+                            seed=1)
+    roidb = merge_roidb([land.gt_roidb(), port.gt_roidb()])
+    loader = AnchorLoader(roidb, cfg, batch_size=2, shuffle=False, seed=0)
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 2, (64, 96))
+    state, tx = create_train_state(cfg, params, steps_per_epoch=2)
+    step = make_train_step(model, tx)
+
+    shapes = set()
+    key = jax.random.PRNGKey(0)
+    for batch in loader:
+        shapes.add(batch["images"].shape[1:3])
+        # aspect grouping: a batch never mixes orientations
+        key, sub = jax.random.split(key)
+        state, m = step(state, batch, sub)
+        assert np.isfinite(float(jax.device_get(m["total_loss"])))
+    assert shapes == {(64, 96), (96, 64)}
