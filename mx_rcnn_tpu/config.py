@@ -224,12 +224,16 @@ _NETWORK_PRESETS = {
         FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
         FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta"),
     ),
+    # FPN shared trunk = backbone stages 1-4 + the neck (lateral*/post* conv
+    # names), so alternate-training rounds 2 keep ALL shared features frozen
     "resnet50_fpn": dict(
         NETWORK="resnet50",
         IMAGE_STRIDE=32,
         HAS_FPN=True,
         RCNN_FEAT_STRIDE=4,
         FPN_ANCHOR_SCALES=(8,),
+        FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3",
+                             "stage4", "lateral", "post", "gamma", "beta"),
     ),
     "resnet101_fpn": dict(
         NETWORK="resnet101",
@@ -237,6 +241,8 @@ _NETWORK_PRESETS = {
         HAS_FPN=True,
         RCNN_FEAT_STRIDE=4,
         FPN_ANCHOR_SCALES=(8,),
+        FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3",
+                             "stage4", "lateral", "post", "gamma", "beta"),
     ),
     "resnet101_fpn_mask": dict(
         NETWORK="resnet101",
@@ -245,6 +251,8 @@ _NETWORK_PRESETS = {
         HAS_MASK=True,
         RCNN_FEAT_STRIDE=4,
         FPN_ANCHOR_SCALES=(8,),
+        FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3",
+                             "stage4", "lateral", "post", "gamma", "beta"),
     ),
 }
 

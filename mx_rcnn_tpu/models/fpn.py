@@ -154,20 +154,14 @@ class FPNFasterRCNN(nn.Module):
         pooled = self._pool_levels(feats, rois, pooled=7)
         return self.rcnn_out(self.head_body(pooled))
 
-    # ---- train graph -------------------------------------------------------
+    # ---- shared training pieces (used by end2end AND the stage graphs) -----
 
-    def __call__(self, images, im_info, gt_boxes, gt_classes, gt_valid, key,
-                 gt_masks: Optional[jnp.ndarray] = None):
-        cfg = self.cfg
-        tr = cfg.TRAIN
-        B = images.shape[0]
-        feats = self._pyramid(images)
-        levels = self._rpn_over_levels(feats)
-
-        keys = jax.random.split(key, (B, 2))
-
-        # RPN targets over the concatenated anchor set (one assign per image
-        # across all levels — standard FPN training)
+    def _rpn_losses(self, levels, im_info, gt_boxes, gt_valid, keys):
+        """Anchor assignment + RPN losses over the concatenated level set
+        (one assign per image across all levels — standard FPN training).
+        Returns (total, aux)."""
+        tr = self.cfg.TRAIN
+        B = gt_boxes.shape[0]
         all_cls = jnp.concatenate([c for c, _, _ in levels], axis=1)
         all_bbox = jnp.concatenate([b for _, b, _ in levels], axis=1)
         all_anc = jnp.concatenate([a for _, _, a in levels], axis=0)
@@ -179,9 +173,20 @@ class FPNFasterRCNN(nn.Module):
                 neg_overlap=tr.RPN_NEGATIVE_OVERLAP,
                 allowed_border=tr.RPN_ALLOWED_BORDER,
                 clobber_positives=tr.RPN_CLOBBER_POSITIVES)
-        )(gt_boxes, gt_valid, im_info, keys[:, 0])
+        )(gt_boxes, gt_valid, im_info, keys)
+        rpn_cls_loss = L.softmax_ce_ignore(all_cls, assign["label"])
+        rpn_bbox_loss = L.smooth_l1(all_bbox, assign["bbox_target"],
+                                    assign["bbox_weight"], sigma=3.0,
+                                    norm=float(tr.RPN_BATCH_SIZE) * B)
+        aux = {"rpn_cls_loss": rpn_cls_loss, "rpn_bbox_loss": rpn_bbox_loss,
+               "rpn_label": assign["label"],
+               "rpn_pred": jnp.argmax(all_cls, axis=-1)}
+        return rpn_cls_loss + rpn_bbox_loss, aux
 
-        # proposals: per-level top-k + joint NMS
+    def _propose_train(self, levels, im_info):
+        """Training-config proposals: per-level top-k + joint NMS (non-
+        differentiable by the Proposal-op contract)."""
+        tr = self.cfg.TRAIN
         level_scores = [jax.lax.stop_gradient(jax.nn.softmax(c, axis=-1)[..., 1])
                         for c, _, _ in levels]
         level_deltas = [jax.lax.stop_gradient(b) for _, b, _ in levels]
@@ -194,7 +199,15 @@ class FPNFasterRCNN(nn.Module):
                 nms_thresh=tr.RPN_NMS_THRESH, min_size=tr.RPN_MIN_SIZE,
                 use_pallas=tr.CXX_PROPOSAL),
         )(tuple(level_scores), tuple(level_deltas), im_info)
+        return rois, roi_valid
 
+    def _rcnn_losses(self, feats, rois, roi_valid, gt_boxes, gt_classes,
+                     gt_valid, keys):
+        """RoI sampling (ProposalTarget contract) + box-head losses.
+        Returns (total, aux, tgt)."""
+        cfg = self.cfg
+        tr = cfg.TRAIN
+        B = gt_boxes.shape[0]
         rois_aug = jnp.concatenate([rois, gt_boxes], axis=1)
         valid_aug = jnp.concatenate([roi_valid, gt_valid], axis=1)
         tgt = jax.vmap(
@@ -204,31 +217,37 @@ class FPNFasterRCNN(nn.Module):
                 fg_fraction=tr.FG_FRACTION, fg_thresh=tr.FG_THRESH,
                 bg_thresh_hi=tr.BG_THRESH_HI, bg_thresh_lo=tr.BG_THRESH_LO,
                 bbox_means=tr.BBOX_MEANS, bbox_stds=tr.BBOX_STDS)
-        )(rois_aug, valid_aug, gt_boxes, gt_classes, gt_valid, keys[:, 1])
+        )(rois_aug, valid_aug, gt_boxes, gt_classes, gt_valid, keys)
         tgt = jax.tree.map(jax.lax.stop_gradient, tgt)
-
         cls_logits, bbox_out = self._box_head(feats, tgt["rois"])
-
-        rpn_cls_loss = L.softmax_ce_ignore(all_cls, assign["label"])
-        rpn_bbox_loss = L.smooth_l1(all_bbox, assign["bbox_target"],
-                                    assign["bbox_weight"], sigma=3.0,
-                                    norm=float(tr.RPN_BATCH_SIZE) * B)
         rcnn_cls_loss = L.softmax_ce_weighted(cls_logits, tgt["label"],
                                               tgt["label_weight"])
         rcnn_bbox_loss = L.smooth_l1(bbox_out, tgt["bbox_target"],
                                      tgt["bbox_weight"], sigma=1.0,
                                      norm=float(tr.BATCH_ROIS) * B)
-        total = rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss + rcnn_bbox_loss
+        aux = {"rcnn_cls_loss": rcnn_cls_loss, "rcnn_bbox_loss": rcnn_bbox_loss,
+               "rcnn_label": tgt["label"],
+               "rcnn_pred": jnp.argmax(cls_logits, axis=-1),
+               "rcnn_label_weight": tgt["label_weight"]}
+        return rcnn_cls_loss + rcnn_bbox_loss, aux, tgt
 
-        aux = {
-            "rpn_cls_loss": rpn_cls_loss, "rpn_bbox_loss": rpn_bbox_loss,
-            "rcnn_cls_loss": rcnn_cls_loss, "rcnn_bbox_loss": rcnn_bbox_loss,
-            "rpn_label": assign["label"],
-            "rpn_pred": jnp.argmax(all_cls, axis=-1),
-            "rcnn_label": tgt["label"],
-            "rcnn_pred": jnp.argmax(cls_logits, axis=-1),
-            "rcnn_label_weight": tgt["label_weight"],
-        }
+    # ---- train graph -------------------------------------------------------
+
+    def __call__(self, images, im_info, gt_boxes, gt_classes, gt_valid, key,
+                 gt_masks: Optional[jnp.ndarray] = None):
+        cfg = self.cfg
+        B = images.shape[0]
+        feats = self._pyramid(images)
+        levels = self._rpn_over_levels(feats)
+        keys = jax.random.split(key, (B, 2))
+
+        rpn_total, rpn_aux = self._rpn_losses(levels, im_info, gt_boxes,
+                                              gt_valid, keys[:, 0])
+        rois, roi_valid = self._propose_train(levels, im_info)
+        rcnn_total, rcnn_aux, tgt = self._rcnn_losses(
+            feats, rois, roi_valid, gt_boxes, gt_classes, gt_valid, keys[:, 1])
+        total = rpn_total + rcnn_total
+        aux = {**rpn_aux, **rcnn_aux}
 
         if cfg.network.HAS_MASK and gt_masks is not None:
             pooled14 = self._pool_levels(feats, tgt["rois"], pooled=14)
@@ -292,6 +311,34 @@ class FPNFasterRCNN(nn.Module):
         predict_with_feats + masks_from_feats)."""
         del im_info
         return self.masks_from_feats(self._pyramid(images), boxes, labels)
+
+    # ---- alternate-training stage graphs (classic pipeline on FPN) ---------
+
+    def rpn_train(self, images, im_info, gt_boxes, gt_valid, key):
+        """RPN-only training over the pyramid (alternate steps 1/4)."""
+        B = images.shape[0]
+        feats = self._pyramid(images)
+        levels = self._rpn_over_levels(feats)
+        return self._rpn_losses(levels, im_info, gt_boxes, gt_valid,
+                                jax.random.split(key, B))
+
+    def rcnn_train(self, images, im_info, rois, roi_valid, gt_boxes,
+                   gt_classes, gt_valid, key):
+        """Box-head training on supplied proposals (alternate steps 3/6).
+
+        Mask configs must train end2end — the stage pipeline has no mask
+        targets, and silently leaving the mask head at init would produce
+        garbage masks at eval."""
+        if self.cfg.network.HAS_MASK:
+            raise NotImplementedError(
+                "alternate training has no mask-target path; train mask "
+                "configs end2end (train_end2end.py)")
+        B = images.shape[0]
+        feats = self._pyramid(images)
+        total, aux, _ = self._rcnn_losses(
+            feats, rois, roi_valid, gt_boxes, gt_classes, gt_valid,
+            jax.random.split(key, B))
+        return total, aux
 
     def predict_rpn(self, images, im_info):
         te = self.cfg.TEST

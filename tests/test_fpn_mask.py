@@ -108,6 +108,28 @@ def test_mask_train_graph():
     assert (p >= 0).all() and (p <= 1).all()
 
 
+def test_fpn_stage_graphs():
+    """Alternate-training stage graphs on the FPN model (rpn_train /
+    predict_rpn / rcnn_train)."""
+    cfg = fpn_cfg()
+    model = build_model(cfg)
+    key = jax.random.PRNGKey(2)
+    params = init_params(model, cfg, key, 2, (64, 96))
+    imgs, im_info, gtb, gtc, gtv = batch()
+
+    tot, aux = jax.jit(lambda p, k: model.apply(
+        {"params": p}, imgs, im_info, gtb, gtv, k,
+        method=model.rpn_train))(params, key)
+    assert np.isfinite(float(tot)) and float(aux["rpn_cls_loss"]) > 0
+
+    rois, _, rvalid = jax.jit(lambda p: model.apply(
+        {"params": p}, imgs, im_info, method=model.predict_rpn))(params)
+    tot2, aux2 = jax.jit(lambda p, k: model.apply(
+        {"params": p}, imgs, im_info, rois, rvalid, gtb, gtc, gtv, k,
+        rngs={"dropout": k}, method=model.rcnn_train))(params, key)
+    assert np.isfinite(float(tot2)) and float(aux2["rcnn_cls_loss"]) > 0
+
+
 # --- mask target oracle ------------------------------------------------------
 
 def test_mask_targets_identity_roi():

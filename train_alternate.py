@@ -43,6 +43,10 @@ def parse_args():
 
 def alternate_train(args):
     cfg = config_from_args(args, train=True)
+    if cfg.network.HAS_MASK:
+        raise NotImplementedError(
+            "alternate training has no mask-target path; train mask configs "
+            "end2end (train_end2end.py)")
     imdb = get_imdb(args, cfg)
     roidb = get_train_roidb(imdb, cfg)
     model = build_model(cfg)
