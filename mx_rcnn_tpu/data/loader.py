@@ -176,15 +176,39 @@ class AnchorLoader:
             batches = [batches[i] for i in order]
         return batches
 
-    def _produce(self) -> Iterator[Dict[str, np.ndarray]]:
-        scale = self.cfg.tpu.SCALES[0]
-        for chunk in self._epoch_indices():
+    def _epoch_plan(self) -> List[Tuple[np.ndarray, Tuple[int, int]]]:
+        """(batch indices, scale bucket) for one epoch.
+
+        Multi-scale training: one scale bucket per BATCH (upstream
+        py-faster-rcnn samples cfg.TRAIN.SCALES per image; with
+        BATCH_IMAGES=1 per-batch ≡ per-image, and for larger batches it
+        preserves the one-bucket-per-batch static-shape invariant — each
+        (scale, orientation) pair is its own compiled program).
+        Deterministic loaders (shuffle=False: eval, proposal dumps) pin
+        SCALES[0] like the reference's single-scale TEST path.
+
+        All RNG draws happen here, on the caller's thread at epoch start —
+        the producer generator must stay RNG-free because an abandoned
+        prefetch thread can overlap a re-iteration's new thread, and the
+        shared RandomState is not thread-safe.
+        """
+        batches = self._epoch_indices()
+        scales = self.cfg.tpu.SCALES
+        if self.shuffle and len(scales) > 1:
+            chosen = [scales[self._rng.randint(len(scales))] for _ in batches]
+        else:
+            chosen = [scales[0]] * len(batches)
+        return list(zip(batches, chosen))
+
+    def _produce(self, plan) -> Iterator[Dict[str, np.ndarray]]:
+        for chunk, scale in plan:
             yield _stack([_load_record(self.roidb[i], self.cfg, scale,
                                        with_masks=True)
                           for i in chunk])
 
     def __iter__(self):
-        return iter(_Prefetcher(self._produce(), self.cfg.tpu.PREFETCH))
+        plan = self._epoch_plan()  # RNG on the consumer thread only
+        return iter(_Prefetcher(self._produce(plan), self.cfg.tpu.PREFETCH))
 
 
 class TestLoader:
@@ -244,10 +268,13 @@ class ROIIter:
     def __iter__(self):
         cfg = self.cfg
         p_max = cfg.TRAIN.RPN_POST_NMS_TOP_N
+        # same per-batch scale-bucket plan as AnchorLoader (upstream samples
+        # TRAIN.SCALES in the Fast-RCNN path too); proposals are in the
+        # original image frame and rescale by each batch's own im_scale
+        plan = self._inner._epoch_plan()
 
         def produce():
-            scale = cfg.tpu.SCALES[0]
-            for chunk in self._inner._epoch_indices():
+            for chunk, scale in plan:
                 samples = []
                 for i in chunk:
                     rec = self._inner.roidb[i]

@@ -20,7 +20,54 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+
+class StemConvS2D(nn.Module):
+    """The ResNet 7×7/2 stem conv, computed via space-to-depth.
+
+    A direct 7×7 stride-2 conv on a 3-channel image contracts only
+    7·7·3 = 147 values per output but feeds the MXU 3-channel-deep input
+    tiles — measured ~0.5 TFLOP/s on v5-lite, making the stem nearly half
+    of the whole ResNet-101 body's fwd+bwd time.  Rewriting x as 2×2
+    space-to-depth blocks (H/2, W/2, 12) turns the same math into a 4×4
+    stride-1 conv with a 4·4·12 = 192-deep contraction that tiles onto the
+    MXU properly.  Derivation: with a = 2A + di − 1 (a the original tap,
+    A the s2d tap, di the in-block offset), the 7×7 kernel left-padded to
+    8×8 and regrouped as (4, 2, 4, 2, 3) gives
+    y[p,q,o] = Σ_{A,B,di,dj,c} X[p+A−2, q+B−2… pad (2,1)] · W — exact,
+    not an approximation (the padded row/col multiplies zeros only).
+
+    The parameter keeps the reference layout (7, 7, 3, 64) under the same
+    ``conv1/kernel`` path as ``nn.Conv(name="conv1")``, so checkpoints and
+    the torch converter are unaffected.  Odd input sizes fall back to the
+    direct conv (scale buckets are all even, but ``demo.py`` accepts
+    arbitrary images).
+    """
+
+    features: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.param("kernel", nn.initializers.lecun_normal(),
+                       (7, 7, 3, self.features), jnp.float32)
+        k = k.astype(self.dtype)
+        x = x.astype(self.dtype)
+        b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            return jax.lax.conv_general_dilated(
+                x, k, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        xs = x.reshape(b, h // 2, 2, w // 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+        xs = xs.reshape(b, h // 2, w // 2, 4 * c)
+        kp = jnp.pad(k, ((1, 0), (1, 0), (0, 0), (0, 0)))  # 8×8, zero tap 0
+        kp = kp.reshape(4, 2, 4, 2, 3, self.features).transpose(0, 2, 1, 3, 4, 5)
+        kp = kp.reshape(4, 4, 4 * c, self.features)
+        return jax.lax.conv_general_dilated(
+            xs, kp, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 class FrozenBN(nn.Module):
@@ -116,8 +163,7 @@ class ResNetConv(nn.Module):
     def __call__(self, x):
         units = RESNET_UNITS[self.depth]
         x = x.astype(self.dtype)
-        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, dtype=self.dtype, name="conv1")(x)
+        x = StemConvS2D(dtype=self.dtype, name="conv1")(x)
         x = FrozenBN(dtype=self.dtype, name="bn1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])

@@ -1,0 +1,45 @@
+"""StemConvS2D must be numerically identical to the direct 7×7/2 conv it
+replaces (reference: ``rcnn/symbol/symbol_resnet.py`` conv0/conv1 — the
+space-to-depth regrouping is a TPU layout optimization, not a model change),
+and keep the reference's checkpoint-compatible (7, 7, 3, 64) kernel layout.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.models.backbones import StemConvS2D
+
+
+@pytest.mark.parametrize("hw", [(64, 96), (63, 97), (62, 95), (61, 96)])
+def test_s2d_stem_matches_direct_conv(rng, hw):
+    h, w = hw
+    x = jnp.asarray(rng.randn(2, h, w, 3), jnp.float32)
+    mod = StemConvS2D(dtype=jnp.float32)
+    params = mod.init(jax.random.PRNGKey(1), x)
+    assert params["params"]["kernel"].shape == (7, 7, 3, 64)
+
+    direct = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3)] * 2,
+                     use_bias=False, dtype=jnp.float32)
+    y_s2d = mod.apply(params, x)
+    y_ref = direct.apply({"params": {"kernel": params["params"]["kernel"]}}, x)
+    assert y_s2d.shape == y_ref.shape
+    np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_s2d_stem_grad_matches(rng):
+    x = jnp.asarray(rng.randn(1, 64, 96, 3), jnp.float32)
+    mod = StemConvS2D(dtype=jnp.float32)
+    params = mod.init(jax.random.PRNGKey(1), x)
+    direct = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3)] * 2,
+                     use_bias=False, dtype=jnp.float32)
+
+    g1 = jax.grad(lambda p: jnp.sum(mod.apply(p, x) ** 2))(params)
+    g2 = jax.grad(lambda p: jnp.sum(direct.apply(p, x) ** 2))(
+        {"params": {"kernel": params["params"]["kernel"]}})
+    np.testing.assert_allclose(np.asarray(g1["params"]["kernel"]),
+                               np.asarray(g2["params"]["kernel"]),
+                               atol=2e-2, rtol=1e-4)

@@ -44,3 +44,45 @@ def test_mixed_orientation_buckets_train():
         state, m = step(state, batch, sub)
         assert np.isfinite(float(jax.device_get(m["total_loss"])))
     assert shapes == {(64, 96), (96, 64)}
+
+
+def test_multi_scale_buckets_train():
+    """Multi-scale training (len(SCALES) > 1): the loader samples one scale
+    bucket per batch; each (scale, orientation) shape is its own compiled
+    program through the same step fn."""
+    cfg = generate_config(
+        "resnet50", "PascalVOC",
+        TRAIN__RPN_PRE_NMS_TOP_N=200, TRAIN__RPN_POST_NMS_TOP_N=32,
+        TRAIN__BATCH_ROIS=16,
+    )
+    cfg = cfg.replace(
+        network=dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4),
+                                    PIXEL_STDS=(127.0, 127.0, 127.0)),
+        tpu=dataclasses.replace(cfg.tpu, SCALES=((64, 96), (96, 128)),
+                                MAX_GT=4))
+    ds = SyntheticDataset(num_images=8, num_classes=5, height=64, width=96,
+                          seed=0)
+    loader = AnchorLoader(ds.gt_roidb(), cfg, batch_size=2, shuffle=True,
+                          seed=3)
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 2, (64, 96))
+    state, tx = create_train_state(cfg, params, steps_per_epoch=4)
+    step = make_train_step(model, tx)
+
+    shapes = set()
+    key = jax.random.PRNGKey(0)
+    for _ in range(3):  # several epochs so both scales get sampled
+        for batch in loader:
+            shapes.add(batch["images"].shape[1:3])
+            key, sub = jax.random.split(key)
+            state, m = step(state, batch, sub)
+            assert np.isfinite(float(jax.device_get(m["total_loss"])))
+        if len(shapes) > 1:
+            break
+    assert len(shapes) == 2, shapes
+    # gt must be scaled into each batch's own resized frame (im_info s)
+    for batch in loader:
+        s = batch["im_info"][0, 2]
+        assert np.all(batch["gt_boxes"][batch["gt_valid"]] <=
+                      max(batch["images"].shape[1:3]) + 1), s
+        break
