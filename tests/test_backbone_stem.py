@@ -43,3 +43,63 @@ def test_s2d_stem_grad_matches(rng):
     np.testing.assert_allclose(np.asarray(g1["params"]["kernel"]),
                                np.asarray(g2["params"]["kernel"]),
                                atol=2e-2, rtol=1e-4)
+
+
+def test_bottleneck_bn_fold_matches_explicit(rng):
+    """Folded conv+FrozenBN (ScaledConv) must equal the explicit
+    conv -> affine sequence.  Run at highest matmul precision: at default
+    precision this build rounds conv operands to bf16, where scaling the
+    kernel before vs after the conv differs by ~1e-2 — the model's normal
+    bf16 noise floor, not a fold error."""
+    import flax
+
+    from mx_rcnn_tpu.models.backbones import Bottleneck
+
+    x = jnp.asarray(rng.randn(2, 16, 24, 64), jnp.float32)
+    mod = Bottleneck(16, strides=2, project=True, dtype=jnp.float32)
+    params = mod.init(jax.random.PRNGKey(0), x)
+    flat = flax.traverse_util.flatten_dict(params["params"])
+    for k in list(flat):  # nontrivial BN params so the fold is exercised
+        if k[-1] in ("gamma", "beta", "mean"):
+            flat[k] = jnp.asarray(rng.randn(*flat[k].shape) * 0.5 +
+                                  (1.0 if k[-1] == "gamma" else 0.0),
+                                  jnp.float32)
+        elif k[-1] == "var":
+            flat[k] = jnp.asarray(np.abs(rng.randn(*flat[k].shape)) + 0.5,
+                                  jnp.float32)
+    params = {"params": flax.traverse_util.unflatten_dict(flat)}
+
+    def bn(h, pre):
+        s = flat[(pre, "gamma")] / jnp.sqrt(flat[(pre, "var")] + 2e-5)
+        b = flat[(pre, "beta")] - flat[(pre, "mean")] * s
+        return h * s + b
+
+    def conv(h, pre, stride, k):
+        return jax.lax.conv_general_dilated(
+            h, flat[(pre, "kernel")], (stride, stride), [(k // 2, k // 2)] * 2,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    with jax.default_matmul_precision("highest"):
+        y_folded = mod.apply(params, x)
+        out = jax.nn.relu(bn(conv(x, "conv1", 1, 1), "bn1"))
+        out = jax.nn.relu(bn(conv(out, "conv2", 2, 3), "bn2"))
+        out = bn(conv(out, "conv3", 1, 1), "bn3")
+        sc = bn(conv(x, "sc_conv", 2, 1), "sc_bn")
+        y_ref = jax.nn.relu(out + sc)
+    np.testing.assert_allclose(np.asarray(y_folded), np.asarray(y_ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_scaled_conv_extra_batch_dims(rng):
+    """ScaledConv folds leading batch dims like nn.Conv (stage-5 RoI heads
+    run over (B, R, h, w, C) features)."""
+    from mx_rcnn_tpu.models.backbones import ScaledConv
+
+    x = jnp.asarray(rng.randn(2, 3, 8, 8, 16), jnp.float32)
+    mod = ScaledConv(8, 3, 1, dtype=jnp.float32)
+    p = mod.init(jax.random.PRNGKey(0), x)
+    y = mod.apply(p, x)
+    assert y.shape == (2, 3, 8, 8, 8)
+    y_flat = mod.apply(p, x.reshape(6, 8, 8, 16))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_flat).reshape(y.shape),
+                               rtol=1e-5, atol=1e-5)

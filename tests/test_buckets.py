@@ -80,9 +80,18 @@ def test_multi_scale_buckets_train():
         if len(shapes) > 1:
             break
     assert len(shapes) == 2, shapes
-    # gt must be scaled into each batch's own resized frame (im_info s)
-    for batch in loader:
-        s = batch["im_info"][0, 2]
-        assert np.all(batch["gt_boxes"][batch["gt_valid"]] <=
-                      max(batch["images"].shape[1:3]) + 1), s
-        break
+    # gt must be scaled into each batch's own resized frame: load the same
+    # record at both scale buckets and check boxes == original * im_scale
+    from mx_rcnn_tpu.data.loader import _load_record
+
+    rec = ds.gt_roidb()[0]
+    orig = np.asarray(rec["boxes"], np.float32)
+    for scale in cfg.tpu.SCALES:
+        sample = _load_record(rec, cfg, scale)
+        s = sample["im_info"][2]
+        n = int(sample["gt_valid"].sum())
+        np.testing.assert_allclose(sample["gt_boxes"][:n], orig[:n] * s,
+                                   rtol=1e-5, atol=1e-4)
+    s_small = _load_record(rec, cfg, cfg.tpu.SCALES[0])["im_info"][2]
+    s_large = _load_record(rec, cfg, cfg.tpu.SCALES[1])["im_info"][2]
+    assert s_large > s_small  # the two buckets genuinely differ
