@@ -8,4 +8,5 @@ multi-slice), batch sharded over data, params replicated, gradient
 all-reduce performed by XLA-inserted collectives.
 """
 
-from mx_rcnn_tpu.parallel.mesh import make_mesh, shard_batch, MeshPlan
+from mx_rcnn_tpu.parallel.mesh import (MeshPlan, make_mesh,
+                                        make_multislice_mesh, shard_batch)

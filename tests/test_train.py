@@ -3,6 +3,7 @@ the sharded train step on the virtual 8-device CPU mesh (SURVEY §4 pyramid
 item 4 — mesh exercised without a pod)."""
 
 import dataclasses
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -180,3 +181,52 @@ def test_sharded_train_step_updates_and_freezes():
     bank = MetricBank()
     bank.update(m)
     assert "RPNAcc" in bank.get()
+
+
+def test_multislice_mesh_matches_flat_dp():
+    """Hierarchical (dcn=2, data=4) multi-slice DP must produce the same
+    step as the flat 8-way mesh: the global gradient mean is mesh-layout
+    invariant, XLA just schedules the reduce as ICI-within-slice +
+    DCN-across-slices."""
+    from mx_rcnn_tpu.parallel import make_multislice_mesh
+
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    batch = make_batch(B=8)
+
+    results = []
+    for plan in (make_mesh(data=8),
+                 make_multislice_mesh(slices=2, data_per_slice=4)):
+        state, tx = create_train_state(cfg, params, steps_per_epoch=10)
+        step = make_train_step(model, tx, plan=plan)
+        state = jax.device_put(state, plan.replicated())
+        for i in range(2):
+            sb = shard_batch(plan, batch)
+            state, metrics = step(state, sb, jax.random.PRNGKey(i))
+        results.append((float(jax.device_get(metrics["total_loss"])),
+                        np.asarray(state.params["rpn"]["rpn_conv_3x3"]["kernel"])))
+
+    assert results[1][0] == pytest.approx(results[0][0], rel=1e-5)
+    np.testing.assert_allclose(results[1][1], results[0][1], rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_multislice_mesh_shapes():
+    from mx_rcnn_tpu.parallel import make_multislice_mesh
+
+    plan = make_multislice_mesh(slices=2)
+    assert plan.mesh.axis_names == ("dcn", "data", "model")
+    assert plan.mesh.shape["dcn"] == 2 and plan.mesh.shape["data"] == 4
+    assert plan.n_data == 8 and plan.batch_axes == ("dcn", "data")
+    with pytest.raises(ValueError):
+        make_multislice_mesh()  # no topology and no slice count
+
+
+def test_multislice_mesh_validation():
+    from mx_rcnn_tpu.parallel import make_multislice_mesh
+
+    with pytest.raises(ValueError):
+        make_multislice_mesh(slices=3)  # 8 devices don't divide into 3
+    with pytest.raises(ValueError):
+        make_multislice_mesh(slices=0)
