@@ -3,24 +3,40 @@
 
 The CUDA kernel computes a 64-bit suppression bitmask per (box, block) pair
 on device and does the greedy sweep on host.  Here both phases stay on
-device:
+device, and — like the CUDA original — the suppression matrix is BIT-PACKED
+(32 consecutive columns per int32 word; signed because Mosaic lacks
+unsigned reduces — bit ops are two's-complement safe and extraction masks
+after the shift):
 
-* **Phase A** (``_suppress_kernel``): grid over (row, col) tiles; each tile
-  computes the IoU of a (BR, BC) box block pair on the VPU and writes
-  ``iou > thresh`` as an int8 suppression matrix tile to HBM.  O(N²) pairs,
-  fully parallel, bandwidth-bound (N² bytes ≈ 150 MB at N=12k ≈ ~0.2 ms of
-  HBM traffic).
-* **Phase B** (``_sweep_kernel``): the greedy sweep.  Sequential by nature,
-  but resolved ``_BS`` rows at a time: grid over row blocks (Pallas
-  auto-double-buffers the HBM→VMEM tile stream); scratch holds the
-  ``removed`` vector across grid steps (TPU grids are sequential);
-  intra-block dependencies come from a precomputed block-diagonal
-  (see the kernel docstring).
+* **Phase A** (``_suppress_kernel``): 2D grid over (row tile, col-word
+  tile); each step computes the IoU of its (BR) rows against its column
+  words and packs ``iou > thresh`` into (BR, CW) words.  The kernel
+  iterates 32 unrolled "bit lanes": pass j compares the rows against the
+  column set {32w + j : w}, whose boxes are pre-gathered OUTSIDE the
+  kernel into row j of a (32, N/32) array — so in-kernel access is a
+  contiguous slice, never strided.  Tiles strictly below the diagonal are
+  skipped entirely (the sweep only ever reads a row's bits at its own
+  block's word and above, and the word-aligned row tiling keeps skipped
+  garbage out of every later read).  The packed write is ≤ N²/8 bytes
+  (18 MB at N=12k vs 147 MB unpacked), and ~⅓ of the IoU work is skipped
+  at this tile shape.
+* **Phase B** (``_sweep_kernel``): the greedy sweep, ``_BS``=8 rows per
+  step.  Sequential by nature, and the expensive part of earlier versions
+  was vector→scalar latency (~16 cross-lane reductions per block).  The
+  packed layout kills that: a block's 8 columns are 8-aligned bits of ONE
+  word, so suppressed-by-earlier/valid state is read with ONE masked
+  reduce each; the 8×8 intra-block dependency table arrives bit-packed in
+  SMEM (two words per block, scalar-indexed), so the serial greedy
+  resolution runs entirely in scalar registers; ``keep`` is written once
+  per block and ``removed`` is updated with one masked OR over the
+  (_BS, N/32) row words.  Early termination: selection order is score
+  order (sorted input), so once ``max_out`` boxes are kept the remaining
+  blocks are predicated off (kept count in SMEM scratch).
 
 Boxes must arrive score-sorted (the ``propose`` contract — jax.lax.top_k
 upstream).  Same greedy tie/threshold semantics as ``ops.nms.nms_padded``
 (suppress when IoU > thresh, legacy +1 areas), which remains the oracle in
-tests (tests/test_nms_pallas.py).
+tests (tests/test_nms.py) and on-chip (scripts/check_pallas.py).
 """
 
 from __future__ import annotations
@@ -32,58 +48,55 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_BR = 256    # row tile (int8 sublane multiple)
-_BC = 2048   # col tile (lane multiple)
-_BS = 8      # sweep block: rows resolved per step (8-aligned, divides _BR)
+_BR = 256    # row tile (sublane multiple)
+_BS = 8      # sweep block: rows resolved per step (8-aligned, divides 32)
+_PL = 32     # bits per packed word
+# n_pad must satisfy: n_pad % _BR == 0 and (n_pad // _PL) % 128 == 0
+_PAD = 4096
 
 
 def _suppress_kernel(thresh_ref, rbox_ref, cx1_ref, cy1_ref, cx2_ref,
                      cy2_ref, out_ref):
-    rb = rbox_ref[:]                     # (BR, 4) f32
-    rx1, ry1 = rb[:, 0:1], rb[:, 1:2]    # (BR, 1)
-    rx2, ry2 = rb[:, 2:3], rb[:, 3:4]
-    cx1, cy1 = cx1_ref[:], cy1_ref[:]    # (1, BC)
-    cx2, cy2 = cx2_ref[:], cy2_ref[:]
+    # 2D grid (row tile, col-word tile).  Tiles strictly below the diagonal
+    # are skipped: the sweep reads sup[g, col] only for col ≥ the block's
+    # own columns, and stale VMEM in a skipped tile's output only lands in
+    # words no later block ever reads (row tiles are word-aligned, so a
+    # row's garbage words all lie strictly below every later block's word).
+    r = pl.program_id(0)
+    c = pl.program_id(1)
+    cw = out_ref.shape[1]                # col-word tile width
 
-    iw = jnp.minimum(rx2, cx2) - jnp.maximum(rx1, cx1) + 1.0
-    ih = jnp.minimum(ry2, cy2) - jnp.maximum(ry1, cy1) + 1.0
-    iw = jnp.maximum(iw, 0.0)
-    ih = jnp.maximum(ih, 0.0)
-    inter = iw * ih
-    ra = (rx2 - rx1 + 1.0) * (ry2 - ry1 + 1.0)
-    ca = (cx2 - cx1 + 1.0) * (cy2 - cy1 + 1.0)
-    union = jnp.maximum(ra + ca - inter, 1e-14)
-    out_ref[:] = (inter / union > thresh_ref[0]).astype(jnp.int8)
+    @pl.when((c + 1) * cw * _PL > r * _BR)
+    def _():
+        rb = rbox_ref[:]                     # (BR, 4) f32
+        rx1, ry1 = rb[:, 0:1], rb[:, 1:2]    # (BR, 1)
+        rx2, ry2 = rb[:, 2:3], rb[:, 3:4]
+        ra = (rx2 - rx1 + 1.0) * (ry2 - ry1 + 1.0)
+        t = thresh_ref[0]
+
+        acc = jnp.zeros(out_ref.shape, jnp.int32)
+        for j in range(_PL):             # unrolled bit-lane loop
+            cx1 = cx1_ref[j:j + 1, :]    # (1, CW) — contiguous slice; row j
+            cy1 = cy1_ref[j:j + 1, :]    # holds the boxes of columns 32w+j
+            cx2 = cx2_ref[j:j + 1, :]
+            cy2 = cy2_ref[j:j + 1, :]
+            iw = jnp.maximum(
+                jnp.minimum(rx2, cx2) - jnp.maximum(rx1, cx1) + 1.0, 0.0)
+            ih = jnp.maximum(
+                jnp.minimum(ry2, cy2) - jnp.maximum(ry1, cy1) + 1.0, 0.0)
+            inter = iw * ih
+            ca = (cx2 - cx1 + 1.0) * (cy2 - cy1 + 1.0)
+            union = jnp.maximum(ra + ca - inter, 1e-14)
+            bits = (inter / union > t).astype(jnp.int32)
+            acc = acc | (bits << j)
+        out_ref[:] = acc
 
 
-def _sweep_kernel(max_out_ref, sup_ref, diag8_ref, valid_ref, keep_ref,
+def _sweep_kernel(max_out_ref, diagp_ref, sup_ref, valid_ref, keep_ref,
                   removed_ref, kept_ref):
-    """Greedy sweep, ``_BS`` rows per step.  Mosaic forbids dynamic
-    lane-indexed scalar access, so per-row state is extracted by iota-mask
-    + reduce — the expensive part of a naive one-row-at-a-time sweep (~10
-    full-width vector ops per row).  Here each step resolves a ``_BS``-row
-    block:
-
-    * the block's cross-row dependencies (does accepting row i suppress
-      row j, i<j within the block) come from ``diag8`` — the _BS×_BS
-      block-diagonal of the suppression matrix, precomputed outside the
-      kernel in a sublane-friendly (N, _BS) layout so the block is one
-      8-aligned sublane load instead of _BS full-width extractions;
-    * suppression by earlier blocks is one masked reduce of ``removed``;
-    * the serial intra-block resolution runs unrolled on (_BS, 1) vectors
-      (one vreg each), then ``keep``/``removed`` update with two
-      full-width ops for the whole block.
-
-    ``_BS=8`` measured fastest on v5-lite (vs 16/32: the (_BS, N_pad)
-    masked reduces grow with _BS faster than the per-row savings).
-
-    Early termination: selection order is score order (sorted input), so
-    once ``max_out`` boxes are kept the remaining rows cannot appear in the
-    output — whole blocks are predicated off (kept count in SMEM scratch).
-    """
     pid = pl.program_id(0)
-    n_pad = sup_ref.shape[1]
-    iota = jax.lax.broadcasted_iota(jnp.int32, (1, n_pad), 1)
+    w32 = sup_ref.shape[1]
+    iota_w = jax.lax.broadcasted_iota(jnp.int32, (1, w32), 1)
     rowid = jax.lax.broadcasted_iota(jnp.int32, (_BS, 1), 0)
 
     @pl.when(pid == 0)
@@ -93,37 +106,49 @@ def _sweep_kernel(max_out_ref, sup_ref, diag8_ref, valid_ref, keep_ref,
         kept_ref[0] = 0
 
     def body(i0, _):
-        # dynamic sublane access must be 8-aligned: both loads below are
-        # _BS-row slices at _BS·i0
+        # dynamic sublane access must be 8-aligned: _BS-row slice at _BS·i0
         base = pl.multiple_of(i0 * _BS, _BS)
 
         @pl.when(kept_ref[0] < max_out_ref[0])
         def _():
-            rows8 = sup_ref[pl.ds(base, _BS), :].astype(jnp.int32)
-            d8 = diag8_ref[pl.ds(base, _BS), :]                   # (_BS, _BS)
+            rows8 = sup_ref[pl.ds(base, _BS), :]                  # (_BS, W32)
             g0 = pid * _BR + base
-            blockmask = iota == (g0 + rowid)                      # (_BS, N_pad)
-            rm8 = jnp.sum(jnp.where(blockmask, removed_ref[:], 0),
-                          axis=1, keepdims=True)                  # (_BS, 1)
-            vd8 = jnp.sum(jnp.where(blockmask, valid_ref[:], 0),
-                          axis=1, keepdims=True)
-            pre = ((rm8 == 0) & (vd8 != 0)).astype(jnp.int32)     # (_BS, 1)
+            w0 = g0 // _PL                 # the block's word lane
+            j0 = g0 % _PL                  # its first bit (8-aligned)
+            blk = g0 // _BS
+            wordsel = iota_w == w0                                # (1, W32)
+            # ONE vector->scalar reduce each: the word holding all 8
+            # column bits of this block
+            rm_w = jnp.sum(jnp.where(wordsel, removed_ref[:], 0))
+            vd_w = jnp.sum(jnp.where(wordsel, valid_ref[:], 0))
+            # 8x8 intra-block table, bit-packed two words per block in
+            # SMEM: word k, byte j' (j = 4k + j'), bit i = "accepting row
+            # i suppresses row j".  Scalar-indexed loads.
+            d_lo = diagp_ref[2 * blk]
+            d_hi = diagp_ref[2 * blk + 1]
 
-            acc = jnp.zeros((_BS, 1), jnp.int32)
+            # serial greedy resolution, entirely in scalar registers
+            acc_bits = 0
             cnt = kept_ref[0]
             for j in range(_BS):                                  # unrolled
-                sup_intra = jnp.sum(acc * d8[:, j:j + 1])
-                pre_j = jnp.sum(jnp.where(rowid == j, pre, 0))
-                a_j = ((pre_j != 0) & (sup_intra == 0) &
-                       (cnt < max_out_ref[0])).astype(jnp.int32)
-                acc = acc + jnp.where(rowid == j, a_j, 0)
-                cnt = cnt + a_j
+                dw = d_hi if j >= 4 else d_lo
+                colbits = (dw >> (8 * (j % 4))) & 0xFF
+                a_j = (((rm_w >> (j0 + j)) & 1) == 0) & \
+                      (((vd_w >> (j0 + j)) & 1) != 0) & \
+                      ((colbits & acc_bits) == 0) & \
+                      (cnt < max_out_ref[0])
+                aji = a_j.astype(jnp.int32)
+                acc_bits = acc_bits | (aji << j)
+                cnt = cnt + aji
 
-            accb = acc != 0                                       # (_BS, 1)
-            keep_ref[:] = keep_ref[:] | jnp.max(
-                jnp.where(blockmask & accb, 1, 0), axis=0, keepdims=True)
-            removed_ref[:] = removed_ref[:] | jnp.max(
-                jnp.where(accb, rows8, 0), axis=0, keepdims=True)
+            keep_ref[:] = keep_ref[:] | jnp.where(
+                wordsel, acc_bits << j0, 0)
+            accv = (jnp.full((_BS, 1), acc_bits, jnp.int32) >> rowid) & 1
+            masked = jnp.where(accv != 0, rows8, 0)               # (_BS, W32)
+            orred = masked[0:1]
+            for j in range(1, _BS):                # OR-reduce (not max: these
+                orred = orred | masked[j:j + 1]    # are packed words)
+            removed_ref[:] = removed_ref[:] | orred
             kept_ref[0] = cnt
 
         return 0
@@ -153,7 +178,8 @@ def nms_pallas(boxes: jnp.ndarray, scores: jnp.ndarray, max_out: int,
         return nms_padded(boxes, scores, max_out=max_out,
                           iou_thresh=iou_thresh, valid=valid)
     n = boxes.shape[0]
-    n_pad = _pad_to(n, _BC)   # lane-aligned and divisible by _BR
+    n_pad = _pad_to(n, _PAD)   # (n_pad/_PL) lane-aligned, divisible by _BR
+    w32 = n_pad // _PL
 
     boxes_p = jnp.zeros((n_pad, 4), jnp.float32).at[:n].set(
         boxes.astype(jnp.float32))
@@ -162,64 +188,78 @@ def nms_pallas(boxes: jnp.ndarray, scores: jnp.ndarray, max_out: int,
     else:
         valid_p = jnp.zeros((n_pad,), bool).at[:n].set(valid)
 
-    cols = boxes_p.T.reshape(4, 1, n_pad)  # x1,y1,x2,y2 as (1, N) rows
+    # column boxes regrouped so bit-lane j of the pack loop reads columns
+    # {32w + j} as a contiguous row: (4, W32, 32) -> (4, 32, W32)
+    cols = boxes_p.T.reshape(4, w32, _PL).transpose(0, 2, 1)
     thresh = jnp.asarray([iou_thresh], jnp.float32)
 
+    cw = 128                       # col-word tile: 128 lanes = 4096 columns
     sup = pl.pallas_call(
         _suppress_kernel,
-        grid=(n_pad // _BR, n_pad // _BC),
+        grid=(n_pad // _BR, w32 // cw),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((_BR, 4), lambda i, j: (i, 0),
+            pl.BlockSpec((_BR, 4), lambda r, c: (r, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _BC), lambda i, j: (0, j),
+            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _BC), lambda i, j: (0, j),
+            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _BC), lambda i, j: (0, j),
+            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _BC), lambda i, j: (0, j),
+            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((_BR, _BC), lambda i, j: (i, j),
+        out_specs=pl.BlockSpec((_BR, cw), lambda r, c: (r, c),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n_pad, n_pad), jnp.int8),
+        out_shape=jax.ShapeDtypeStruct((n_pad, w32), jnp.int32),
     )(thresh, boxes_p, cols[0], cols[1], cols[2], cols[3])
 
-    # _BS×_BS block-diagonal of the suppression matrix in (N, _BS) layout:
-    # diag8[g, j] = sup[g, _BS*(g//_BS) + j].  Recomputed via
-    # boxes.bbox_overlaps rather than gathered from sup: a take_along_axis
-    # over the (N, N) int8 sup measures ~2 ms slower on v5-lite (TPU
-    # gathers serialize), while the O(N·_BS) IoU recompute fuses into the
-    # surrounding graph.  Consistency is structural, not numeric: every
-    # same-block pair is decided solely by diag8 and every cross-block
-    # pair solely by sup, so a ULP divergence between the two lowerings
-    # cannot produce contradictory suppression decisions.
+    # 8x8 block-diagonal, bit-packed 2 words per block for SMEM scalar
+    # loads: word k of block r, byte j' (col j = 4k + j'), bit i =
+    # sup[8r+i, 8r+j].  Recomputed via boxes.bbox_overlaps: consistency is
+    # structural — every same-block pair is decided solely by this table
+    # and every cross-block pair solely by sup, so a ULP divergence
+    # between the lowerings cannot produce contradictory decisions.
     from mx_rcnn_tpu.ops.boxes import bbox_overlaps
 
-    gb = boxes_p.reshape(-1, _BS, 4)                     # (N/_BS, _BS, 4)
-    iou_blk = jax.vmap(bbox_overlaps)(gb, gb)            # (N/_BS, _BS, _BS)
-    diag8 = (iou_blk > iou_thresh).astype(jnp.int32).reshape(n_pad, _BS)
+    gb = boxes_p.reshape(-1, _BS, 4)                     # (N/8, 8, 4)
+    iou_blk = jax.vmap(bbox_overlaps)(gb, gb)            # (N/8, 8, 8) [i, j]
+    dbits = (iou_blk > iou_thresh).astype(jnp.int32)
+    rowsh = jnp.arange(_BS, dtype=jnp.int32)[None, :, None]   # bit i
+    colgrp = jnp.sum(dbits << rowsh, axis=1)             # (N/8, 8) per-col j
+    bytesh = (jnp.arange(_BS, dtype=jnp.int32) % 4) * 8  # byte within word
+    packed = colgrp << bytesh[None, :]                   # (N/8, 8)
+    diagp = jnp.stack([
+        packed[:, 0] | packed[:, 1] | packed[:, 2] | packed[:, 3],
+        packed[:, 4] | packed[:, 5] | packed[:, 6] | packed[:, 7],
+    ], axis=1).reshape(-1)                               # (N/8 * 2,)
 
-    keep = pl.pallas_call(
+    # classic packing for valid: word w bit j = valid[32w + j]
+    valid_words = jnp.sum(
+        valid_p.astype(jnp.int32).reshape(w32, _PL) <<
+        jnp.arange(_PL, dtype=jnp.int32)[None, :], axis=1).reshape(1, w32)
+
+    keep_words = pl.pallas_call(
         _sweep_kernel,
         grid=(n_pad // _BR,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((_BR, n_pad), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_BR, _BS), lambda i: (i, 0),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((_BR, w32), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
-        scratch_shapes=[pltpu.VMEM((1, n_pad), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((1, w32), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((1, w32), jnp.int32),
                         pltpu.SMEM((1,), jnp.int32)],
-    )(jnp.asarray([max_out], jnp.int32), sup, diag8,
-      valid_p.astype(jnp.int32).reshape(1, n_pad))
+    )(jnp.asarray([max_out], jnp.int32), diagp, sup, valid_words)
 
-    keep_mask_full = keep[0, :n] > 0
+    # unpack: word w bit j = column 32w + j, C-order reshape restores it
+    keep_bits = ((keep_words[0][:, None] >>
+                  jnp.arange(_PL, dtype=jnp.int32)[None, :]) & 1)
+    keep_mask_full = keep_bits.reshape(n_pad)[:n] > 0
     # kept boxes in index order == score order; compact to max_out slots
     # (pad when n < max_out so the output shape contract always holds)
     order = jnp.argsort(jnp.where(keep_mask_full, 0, 1), stable=True)
