@@ -35,7 +35,10 @@ fails = 0
 for seed in range(5):
     for n, max_out, thresh in ((2048, 300, 0.7), (6000, 300, 0.7),
                                (12000, 2000, 0.7), (4000, 100, 0.3),
-                               (100, 300, 0.5)):  # n < max_out shape contract
+                               (100, 300, 0.5),   # n < max_out shape contract
+                               (4097, 300, 0.7),  # pad-boundary crossing
+                               (4000, 300, 0.99),  # almost nothing suppressed
+                               (4000, 300, 0.01)):  # almost all suppressed
         boxes, scores = gen(n, seed)
         valid = jnp.asarray(np.random.RandomState(seed).rand(n) > 0.02)
         ki_p, km_p = jax.device_get(nms_pallas(boxes, scores, max_out=max_out,
@@ -48,6 +51,23 @@ for seed in range(5):
             fails += 1
             print(f"MISMATCH n={n} max_out={max_out} t={thresh} seed={seed}: "
                   f"kept {km_p.sum()} vs {km_r.sum()}")
+
+# adversarial structure: exact ties / identical boxes / all-invalid
+box1 = jnp.tile(jnp.asarray([[10., 10., 60., 60.]], jnp.float32), (512, 1))
+sc1 = jnp.asarray(np.sort(np.random.RandomState(0).rand(512)
+                          .astype(np.float32))[::-1].copy())
+for name, (b, s, mo, t, v) in {
+    "identical-boxes": (box1, sc1, 300, 0.7, None),
+    "all-invalid": (box1, sc1, 300, 0.7, jnp.zeros((512,), bool)),
+    "single-box": (box1[:1], sc1[:1], 300, 0.7, None),
+}.items():
+    ki_p, km_p = jax.device_get(nms_pallas(b, s, max_out=mo, iou_thresh=t,
+                                           valid=v))
+    ki_r, km_r = jax.device_get(nms_padded(b, s, max_out=mo, iou_thresh=t,
+                                           valid=v))
+    if km_p.sum() != km_r.sum() or not np.array_equal(ki_p[km_p], ki_r[km_r]):
+        fails += 1
+        print(f"MISMATCH [{name}]: kept {km_p.sum()} vs {km_r.sum()}")
 print("equivalence:", "FAIL" if fails else "OK")
 
 # timing (chained, fence by readback)
