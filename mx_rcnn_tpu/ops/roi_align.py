@@ -107,6 +107,12 @@ def roi_align(
     """
     def one(roi):
         ys, xs = _roi_sample_grid(roi, spatial_scale, pooled_size, sampling_ratio)
+        if sampling_ratio == 1:
+            # one sample per bin: no sample axes to reduce, so avg == max
+            # == the single sample and the (P, P, 1, 1, C) intermediate
+            # never exists (simpler graph; device time is unchanged — XLA
+            # already folded the squeeze)
+            return _bilinear(features, ys[:, :, 0, 0], xs[:, :, 0, 0])
         vals = _bilinear(features, ys, xs)  # (P, P, S, S, C)
         if mode == "avg":
             return vals.mean(axis=(2, 3))

@@ -2,8 +2,8 @@
 """Minimal XProf xplane.pb parser: per-op device-time totals without
 tensorboard (the installed tensorboard_plugin_profile is incompatible with
 this TF's protobuf).  Hand-rolled protobuf wire-format walk over the XSpace
-schema (planes=1; XPlane: name=2, lines=3, event_metadata=4; XEvent:
-metadata_id=1, duration_ps=3).
+schema (planes=1; XPlane: name=2, lines=3, event_metadata=4; XLine:
+name=2, events=4; XEvent: metadata_id=1, duration_ps=3).
 
 Usage:
   python - <<'PY'
@@ -77,7 +77,7 @@ def main(path, topn=20):
                 if f3 == 2 and w3 == 2:
                     try: line_name = v3.decode()
                     except Exception: pass
-                if w3 == 2 and f3 not in (2,):
+                if f3 == 4 and w3 == 2:  # XLine.events (probed empirically)
                     try:
                         mid = dur = None
                         for f4, w4, v4 in parse_fields(v3):
