@@ -61,8 +61,13 @@ def build():
                          y1 + rng.randint(60, 199))
             gtc[b, j] = rng.randint(1, 21)
             gtv[b, j] = True
+    images = rng.randn(BATCH, H, W, 3).astype(np.float32)
+    if cfg.network.HOST_S2D:  # ship images like the production loader does
+        from mx_rcnn_tpu.data.image import space_to_depth2
+
+        images = np.stack([space_to_depth2(im) for im in images])
     batch = dict(
-        images=rng.randn(BATCH, H, W, 3).astype(np.float32),
+        images=images,
         im_info=np.tile(np.asarray([[H, W, 1.0]], np.float32), (BATCH, 1)),
         gt_boxes=gtb, gt_classes=gtc, gt_valid=gtv,
     )

@@ -124,6 +124,10 @@ class NetworkConfig:
     ANCHOR_RATIOS: Tuple[float, ...] = (0.5, 1.0, 2.0)
     # FPN (capability target per BASELINE.json configs 4-5; not in classic ref)
     HAS_FPN: bool = False
+    # host-side 2x2 space-to-depth: the loader ships images as
+    # (H/2, W/2, 12) so the stem's s2d regroup costs zero device time
+    # (~1 ms/step of lane-hostile transposes otherwise); ResNet stems only
+    HOST_S2D: bool = False
     FPN_FEAT_STRIDES: Tuple[int, ...] = (4, 8, 16, 32, 64)
     FPN_ANCHOR_SCALES: Tuple[int, ...] = (8,)
     FPN_OUT_CHANNELS: int = 256
@@ -214,12 +218,14 @@ _NETWORK_PRESETS = {
     ),
     "resnet50": dict(
         NETWORK="resnet50",
+        HOST_S2D=True,
         IMAGE_STRIDE=32,
         FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
         FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta"),
     ),
     "resnet101": dict(
         NETWORK="resnet101",
+        HOST_S2D=True,
         IMAGE_STRIDE=32,
         FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
         FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta"),
@@ -228,6 +234,7 @@ _NETWORK_PRESETS = {
     # names), so alternate-training rounds 2 keep ALL shared features frozen
     "resnet50_fpn": dict(
         NETWORK="resnet50",
+        HOST_S2D=True,
         IMAGE_STRIDE=32,
         HAS_FPN=True,
         RCNN_FEAT_STRIDE=4,
@@ -237,6 +244,7 @@ _NETWORK_PRESETS = {
     ),
     "resnet101_fpn": dict(
         NETWORK="resnet101",
+        HOST_S2D=True,
         IMAGE_STRIDE=32,
         HAS_FPN=True,
         RCNN_FEAT_STRIDE=4,
@@ -246,6 +254,7 @@ _NETWORK_PRESETS = {
     ),
     "resnet101_fpn_mask": dict(
         NETWORK="resnet101",
+        HOST_S2D=True,
         IMAGE_STRIDE=32,
         HAS_FPN=True,
         HAS_MASK=True,

@@ -90,3 +90,16 @@ def resize_to_bucket(im: np.ndarray, scale: Tuple[int, int], stride: int):
     out = np.zeros((hb, wb) + im.shape[2:], np.float32)
     out[:eh, :ew] = im_r
     return out, s, (eh, ew)
+
+
+def space_to_depth2(im: np.ndarray) -> np.ndarray:
+    """2×2 space-to-depth: (H, W, C) → (H/2, W/2, 4C), channel order
+    (di, dj, c) — exactly the regroup ``models.backbones.StemConvS2D``
+    performs on device for 3-channel input, hoisted to the host where the
+    prefetch thread hides it (the device-side transpose of the raw image
+    is lane-hostile and costs ~1 ms/step)."""
+    h, w, c = im.shape
+    assert h % 2 == 0 and w % 2 == 0, (h, w)
+    return (im.reshape(h // 2, 2, w // 2, 2, c)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(h // 2, w // 2, 4 * c))

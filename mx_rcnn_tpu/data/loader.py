@@ -24,7 +24,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
-from mx_rcnn_tpu.data.image import get_image, resize_to_bucket, transform_image
+from mx_rcnn_tpu.data.image import (get_image, resize_to_bucket,
+                                    space_to_depth2, transform_image)
 
 
 def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
@@ -42,6 +43,9 @@ def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
     im = transform_image(im, cfg.network.PIXEL_MEANS, cfg.network.PIXEL_STDS)
     stride = max(cfg.network.IMAGE_STRIDE, cfg.network.RPN_FEAT_STRIDE)
     padded, s, (eh, ew) = resize_to_bucket(im, scale, stride)
+
+    if cfg.network.HOST_S2D:
+        padded = space_to_depth2(padded)
 
     g = cfg.tpu.MAX_GT
     boxes = np.zeros((g, 4), np.float32)

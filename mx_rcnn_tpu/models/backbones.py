@@ -61,19 +61,28 @@ class StemConvS2D(nn.Module):
         k = k.astype(self.dtype)
         x = x.astype(self.dtype)
         b, h, w, c = x.shape
-        if h % 2 or w % 2:
+        if c == 12:
+            # input arrived space-to-depth'd on the host (config
+            # network.HOST_S2D — data/image.py:space_to_depth2, same
+            # (di, dj, ch) channel order): skip the device-side regroup,
+            # whose lane-hostile transpose costs ~1 ms/step
+            xs = x
+        elif h % 2 or w % 2:
             y = jax.lax.conv_general_dilated(
                 x, k, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            if shift is not None:
+                y = y + shift.astype(self.dtype)
+            return y
         else:
             xs = (x.reshape(b, h // 2, 2, w // 2, 2, c)
                   .transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c))
-            kp = jnp.pad(k, ((1, 0), (1, 0), (0, 0), (0, 0)))  # 8×8, zero tap 0
-            kp = kp.reshape(4, 2, 4, 2, 3, self.features).transpose(0, 2, 1, 3, 4, 5)
-            kp = kp.reshape(4, 4, 4 * c, self.features)
-            y = jax.lax.conv_general_dilated(
-                xs, kp, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
-                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        kp = jnp.pad(k, ((1, 0), (1, 0), (0, 0), (0, 0)))  # 8×8, zero tap 0
+        kp = kp.reshape(4, 2, 4, 2, 3, self.features).transpose(0, 2, 1, 3, 4, 5)
+        kp = kp.reshape(4, 4, 12, self.features)
+        y = jax.lax.conv_general_dilated(
+            xs, kp, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if shift is not None:
             y = y + shift.astype(self.dtype)
         return y

@@ -103,3 +103,18 @@ def test_scaled_conv_extra_batch_dims(rng):
     y_flat = mod.apply(p, x.reshape(6, 8, 8, 16))
     np.testing.assert_allclose(np.asarray(y), np.asarray(y_flat).reshape(y.shape),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_s2d_stem_accepts_host_s2d_input(rng):
+    """StemConvS2D((H, W, 3)) must equal StemConvS2D(space_to_depth2(x)) —
+    the loader's HOST_S2D path ships the latter with the same params."""
+    from mx_rcnn_tpu.data.image import space_to_depth2
+
+    x = np.asarray(rng.randn(64, 96, 3), np.float32)
+    mod = StemConvS2D(dtype=jnp.float32)
+    params = mod.init(jax.random.PRNGKey(1), jnp.asarray(x[None]))
+    y_dev = mod.apply(params, jnp.asarray(x[None]))
+    y_host = mod.apply(params, jnp.asarray(space_to_depth2(x)[None]))
+    assert y_dev.shape == y_host.shape
+    np.testing.assert_allclose(np.asarray(y_dev), np.asarray(y_host),
+                               rtol=1e-5, atol=1e-5)

@@ -76,7 +76,9 @@ def test_anchor_loader_batches():
     batches = list(loader)
     assert len(batches) == 3
     b = batches[0]
-    assert b["images"].shape == (4, 128, 256, 3)
+    # resnet presets ship images host-space-to-depth'd (HOST_S2D):
+    # (128, 256, 3) bucket -> (64, 128, 12)
+    assert b["images"].shape == (4, 64, 128, 12)
     assert b["im_info"].shape == (4, 3)
     assert b["gt_boxes"].shape == (4, 8, 4)
     assert b["gt_valid"].any()
