@@ -46,8 +46,8 @@ def build():
         cfg.network, PIXEL_STDS=(127.0, 127.0, 127.0)))
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0), BATCH, (H, W))
-    state, tx = create_train_state(cfg, params, steps_per_epoch=1000)
-    step = make_train_step(model, tx)
+    state, tx, mask = create_train_state(cfg, params, steps_per_epoch=1000)
+    step = make_train_step(model, tx, trainable_mask=mask)
 
     rng = np.random.RandomState(0)
     g = cfg.tpu.MAX_GT

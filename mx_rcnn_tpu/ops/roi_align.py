@@ -1,22 +1,31 @@
-"""ROIAlign / ROIPool — pure-JAX reference implementations.
+"""ROIAlign / ROIPool — separable-matmul formulation (MXU-native).
 
 The reference's RoI feature extractor is MXNet's CUDA ``ROIPooling``
 (roi_pooling.cu; 7×7 max pool, spatial_scale 1/16, coordinate rounding).
 The Mask R-CNN capability target uses ROIAlign (bilinear, no rounding).
 
-TPU-first design: both are expressed as dense bilinear gathers with a
-*static* sample grid — (R, P, P, S, S) sample points per RoI — which XLA
-lowers to vectorized gathers; no dynamic shapes, no per-RoI loops.  ROIPool
-is realized as max over the same static sample grid (documented divergence:
-the reference's exact integer-binned max-pool has data-dependent bin
-extents which are hostile to static shapes; a dense 4-sample-per-bin max is
-the standard TPU substitute and is accuracy-neutral-or-better, like
-ROIAlign itself).  A fused Pallas kernel behind the same signature is
-planned (kernels/ tier); this module is the reference path and test oracle.
+TPU-first design (round 2): bilinear interpolation is *separable*, so for
+the avg mode the whole pooled crop of RoI r is two small matmuls
+
+    crop[r] = Ry[r] @ feat @ Rx[r]^T          (per channel)
+
+where ``Ry[r]`` is (P, H) and ``Rx[r]`` is (P, W), each row holding the
+averaged 1-D interpolation weights of that bin's sample points (≤ 2·S
+nonzeros).  Expressed as two einsums this runs entirely on the MXU —
+~12 GFLOPs at the flagship shape (128 RoIs, 14×14, 1024 ch) ≈ 0.1 ms —
+and, crucially, its *backward* is again einsums: the transposed matmuls.
+The round-1 gather formulation spent ~1.2 ms/step gathering forward and
+~2.5 ms/step in four serialized scatter-adds backward (profiled on
+v5-lite); the separable form removes every gather/scatter from the RoI
+path.  Max mode with sampling_ratio > 1 is not separable and keeps the
+dense-gather path (it is off the flagship hot path).
 
 Coordinate semantics follow ROIAlign (Mask R-CNN paper): continuous
-coordinates, half-pixel centers, sampling_ratio points per bin axis,
-average (align) or max (pool) reduction.
+coordinates, half-pixel sample centers within each bin, samples outside
+the feature map contribute 0, coordinates clamped like the CUDA kernel
+(y0 = floor(clip(y)), y1 = min(y0+1, H-1), duplicate-index weights sum).
+The gather path (`_roi_align_gather`) is kept as the test oracle for the
+einsum path and as the max-mode implementation.
 """
 
 from __future__ import annotations
@@ -64,16 +73,22 @@ def _bilinear(feat: jnp.ndarray, y: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(in_range[..., None], out, jnp.zeros((), dt))
 
 
-def _roi_sample_grid(roi: jnp.ndarray, spatial_scale: float, pooled: int, sampling: int):
-    """Sample point grid for one RoI → (pooled, pooled, sampling, sampling) y/x."""
+def _roi_bins(roi: jnp.ndarray, spatial_scale: float, pooled: int):
+    """Shared RoI → bin geometry: (y1, x1, bin_h, bin_w) in feature coords,
+    with the reference's min-1px degenerate-box clamp.  Both the gather and
+    the separable paths derive their sample points from this one function so
+    they stay bit-identical (the gather path is the separable path's test
+    oracle)."""
     x1 = roi[0] * spatial_scale
     y1 = roi[1] * spatial_scale
-    x2 = roi[2] * spatial_scale
-    y2 = roi[3] * spatial_scale
-    roi_w = jnp.maximum(x2 - x1, 1.0)
-    roi_h = jnp.maximum(y2 - y1, 1.0)
-    bin_w = roi_w / pooled
-    bin_h = roi_h / pooled
+    roi_w = jnp.maximum(roi[2] * spatial_scale - x1, 1.0)
+    roi_h = jnp.maximum(roi[3] * spatial_scale - y1, 1.0)
+    return y1, x1, roi_h / pooled, roi_w / pooled
+
+
+def _roi_sample_grid(roi: jnp.ndarray, spatial_scale: float, pooled: int, sampling: int):
+    """Sample point grid for one RoI → (pooled, pooled, sampling, sampling) y/x."""
+    y1, x1, bin_h, bin_w = _roi_bins(roi, spatial_scale, pooled)
 
     py = jnp.arange(pooled, dtype=jnp.float32)
     px = jnp.arange(pooled, dtype=jnp.float32)
@@ -85,6 +100,71 @@ def _roi_sample_grid(roi: jnp.ndarray, spatial_scale: float, pooled: int, sampli
     ys = jnp.broadcast_to(ys, (pooled, pooled, sampling, sampling))
     xs = jnp.broadcast_to(xs, (pooled, pooled, sampling, sampling))
     return ys, xs
+
+
+def _axis_weights(lo, bin_sz, n: int, pooled: int, sampling: int):
+    """1-D interpolation matrix (pooled, n) for one axis of one RoI.
+
+    Row i averages the ``sampling`` sample points of bin i; each sample
+    contributes linear-interpolation weights to its two neighbor cells with
+    exactly `_bilinear`'s edge semantics (out-of-range → 0, clamp, y1 =
+    min(y0+1, n-1) so duplicate indices at the high edge sum to 1).
+    """
+    p = jnp.arange(pooled, dtype=jnp.float32)[:, None]
+    s = (jnp.arange(sampling, dtype=jnp.float32)[None, :] + 0.5) / sampling
+    t = lo + (p + s) * bin_sz                      # (P, S) sample coords
+    ok = (t > -1.0) & (t < n)
+    tc = jnp.clip(t, 0.0, n - 1.0)
+    t0 = jnp.floor(tc)
+    t1 = jnp.minimum(t0 + 1.0, n - 1.0)
+    frac = tc - t0
+    cells = jnp.arange(n, dtype=jnp.float32)       # (n,)
+    w = ((1.0 - frac)[..., None] * (cells == t0[..., None]) +
+         frac[..., None] * (cells == t1[..., None]))   # (P, S, n)
+    w = jnp.where(ok[..., None], w, 0.0)
+    return w.mean(axis=1)                          # (P, n)
+
+
+def _roi_align_separable(features, rois, spatial_scale, pooled, sampling):
+    """Avg-mode ROIAlign as two einsums (see module docstring)."""
+    h, w, _ = features.shape
+
+    def weights(roi):
+        y1, x1, bin_h, bin_w = _roi_bins(roi, spatial_scale, pooled)
+        return (_axis_weights(y1, bin_h, h, pooled, sampling),
+                _axis_weights(x1, bin_w, w, pooled, sampling))
+
+    ry, rx = jax.vmap(weights)(rois)               # (R, P, H), (R, P, W)
+    dt = (features.dtype if jnp.issubdtype(features.dtype, jnp.floating)
+          else jnp.float32)
+    features = features.astype(dt)
+    ry = ry.astype(dt)
+    rx = rx.astype(dt)
+    # contract the LARGER spatial axis first: the (R, P, min(h,w), C)
+    # intermediate is HBM-resident at flagship shapes (~139 MB bf16 vs
+    # ~235 MB the other way on a 38×64 map), and the op is bandwidth-bound
+    if w > h:
+        u = jnp.einsum("rqw,hwc->rqhc", rx, features)
+        return jnp.einsum("rph,rqhc->rpqc", ry, u)
+    u = jnp.einsum("rph,hwc->rpwc", ry, features)
+    return jnp.einsum("rqw,rpwc->rpqc", rx, u)
+
+
+def _roi_align_gather(features, rois, spatial_scale, pooled, sampling, mode):
+    """Dense static-grid gather path (round-1 formulation): needed for max
+    mode at sampling > 1, and serves as the einsum path's test oracle."""
+    def one(roi):
+        ys, xs = _roi_sample_grid(roi, spatial_scale, pooled, sampling)
+        if sampling == 1:
+            # one sample per bin: no sample axes to reduce, so avg == max
+            # == the single sample
+            return _bilinear(features, ys[:, :, 0, 0], xs[:, :, 0, 0])
+        vals = _bilinear(features, ys, xs)  # (P, P, S, S, C)
+        if mode == "avg":
+            return vals.mean(axis=(2, 3))
+        return vals.max(axis=(2, 3))
+
+    return jax.vmap(one)(rois)
 
 
 @partial(jax.jit, static_argnames=("pooled_size", "sampling_ratio", "spatial_scale", "mode"))
@@ -105,20 +185,12 @@ def roi_align(
 
     Returns: (R, pooled, pooled, C).
     """
-    def one(roi):
-        ys, xs = _roi_sample_grid(roi, spatial_scale, pooled_size, sampling_ratio)
-        if sampling_ratio == 1:
-            # one sample per bin: no sample axes to reduce, so avg == max
-            # == the single sample and the (P, P, 1, 1, C) intermediate
-            # never exists (simpler graph; device time is unchanged — XLA
-            # already folded the squeeze)
-            return _bilinear(features, ys[:, :, 0, 0], xs[:, :, 0, 0])
-        vals = _bilinear(features, ys, xs)  # (P, P, S, S, C)
-        if mode == "avg":
-            return vals.mean(axis=(2, 3))
-        return vals.max(axis=(2, 3))
-
-    return jax.vmap(one)(rois)
+    if mode == "avg" or sampling_ratio == 1:
+        # max == avg at one sample per bin, so the separable path covers it
+        return _roi_align_separable(features, rois, spatial_scale,
+                                    pooled_size, sampling_ratio)
+    return _roi_align_gather(features, rois, spatial_scale, pooled_size,
+                             sampling_ratio, mode)
 
 
 def roi_pool(features, rois, *, spatial_scale=1.0 / 16, pooled_size: int = 7,

@@ -14,7 +14,6 @@ KVStore reduce used to be; donation reuses the state buffers in place.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -39,15 +38,20 @@ class TrainState:
 
 def create_train_state(cfg: Config, params, steps_per_epoch: int,
                        begin_epoch: int = 0,
-                       fixed_prefixes=None) -> tuple[TrainState, optax.GradientTransformation]:
+                       fixed_prefixes=None):
+    """-> (TrainState, tx, trainable_mask).  Pass the mask to
+    ``make_train_step`` so frozen subtrees are stop_gradient-ed (XLA then
+    dead-code-eliminates their whole backward chain instead of computing
+    gradients the optimizer would zero anyway)."""
     # copy params into the state: the jitted step donates its state, and
     # aliasing the caller's buffers would delete them after the first step
     # (the alternate-training driver reuses one init tree across stages)
     params = jax.tree.map(lambda x: jnp.array(x, copy=True), params)
-    tx, _ = make_optimizer(cfg, steps_per_epoch, params,
-                           begin_epoch=begin_epoch, fixed_prefixes=fixed_prefixes)
+    tx, _, mask = make_optimizer(cfg, steps_per_epoch, params,
+                                 begin_epoch=begin_epoch,
+                                 fixed_prefixes=fixed_prefixes)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                      opt_state=tx.init(params)), tx
+                      opt_state=tx.init(params)), tx, mask
 
 
 def _loss_fn(params, model, batch, key, graph: str):
@@ -80,19 +84,33 @@ def _loss_fn(params, model, batch, key, graph: str):
 def make_train_step(model, tx: optax.GradientTransformation,
                     plan: Optional[MeshPlan] = None,
                     graph: str = "end2end",
-                    donate: bool = True) -> Callable:
+                    donate: bool = True,
+                    trainable_mask=None) -> Callable:
     """Build ``train_step(state, batch, key) -> (state, metrics)``.
 
     With a ``MeshPlan``, inputs/outputs carry NamedShardings (batch split on
     the data axis, state replicated) — the whole of data parallelism; no
     pmap, no hand-written collectives.  Without one, plain single-device jit
     (the reference's 1-GPU path).
+
+    ``trainable_mask`` (the tree from ``create_train_state``; True =
+    trainable): frozen leaves are ``stop_gradient``-ed inside the loss, so
+    their gradients are structural zeros and XLA dead-code-eliminates the
+    frozen backward tail entirely (the reference freezes conv1+stage1 —
+    ``fixed_param_prefix`` — but still computed those gradients; we don't).
     """
 
     def step(state: TrainState, batch, key):
+        def loss_fn(params):
+            if trainable_mask is not None:
+                params = jax.tree.map(
+                    lambda v, t: v if t else jax.lax.stop_gradient(v),
+                    params, trainable_mask)
+            return _loss_fn(params, model=model, batch=batch, key=key,
+                            graph=graph)
+
         (total, aux), grads = jax.value_and_grad(
-            partial(_loss_fn, model=model, batch=batch, key=key, graph=graph),
-            has_aux=True)(state.params)
+            loss_fn, has_aux=True)(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = metric_scalars(aux)

@@ -61,7 +61,7 @@ def fit(cfg: Config, model, params, train_loader,
     calls this the free win; view with xprof/tensorboard).
     """
     steps_per_epoch = train_loader.steps_per_epoch
-    state, tx = create_train_state(cfg, params, steps_per_epoch,
+    state, tx, mask = create_train_state(cfg, params, steps_per_epoch,
                                    begin_epoch=begin_epoch,
                                    fixed_prefixes=fixed_prefixes)
     ckpt = CheckpointManager(prefix) if prefix else None
@@ -85,7 +85,8 @@ def fit(cfg: Config, model, params, train_loader,
         logger.info("resumed from %s epoch %d (step %d)", prefix, begin_epoch,
                     r_step)
 
-    step_fn = make_train_step(model, tx, plan=plan, graph=graph)
+    step_fn = make_train_step(model, tx, plan=plan, graph=graph,
+                              trainable_mask=mask)
     n_chips = plan.n_data if plan else 1
     speedo = Speedometer(train_loader.batch_size, frequent=frequent,
                          n_chips=n_chips)

@@ -68,8 +68,8 @@ for name in PRESETS:
         cfg = tiny_cfg(name)
         model = build_model(cfg)
         params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (H, W))
-        state, tx = create_train_state(cfg, params, steps_per_epoch=10)
-        step = make_train_step(model, tx)
+        state, tx, mask = create_train_state(cfg, params, steps_per_epoch=10)
+        step = make_train_step(model, tx, trainable_mask=mask)
         batch = make_batch(cfg)
         state, m = step(state, batch, jax.random.PRNGKey(1))
         loss = float(jax.device_get(m["total_loss"]))

@@ -16,6 +16,9 @@ per jit call (the trustworthy number — wall clock on the tunneled device
 adds ~2.4 ms dispatch per chained call and swamps sub-ms effects);
 'XLA Ops' rows are per-op busy times grouped by op family + output
 shape; 'Async XLA Ops' spans overlap compute and must not be summed.
+Each line's busy total naively sums event durations — valid for the
+serial Modules/Ops lines, an overestimate on any line with overlapping
+spans.
 """
 
 import struct, collections, sys, re
@@ -47,7 +50,10 @@ def parse_fields(buf):
             raise ValueError(f"wt {wt}")
         yield fno, wt, v
 
-def main(path, topn=20):
+def iter_tpu_lines(path):
+    """Yield (plane_name, line_name, [(op_name, duration_ps), ...]) for every
+    line of every TPU plane in the capture.  Multi-chip captures yield one
+    group of lines per device plane."""
     data = open(path, "rb").read()
     for fno, wt, plane_buf in parse_fields(data):
         if fno != 1:
@@ -87,28 +93,49 @@ def main(path, topn=20):
                             evs.append((meta[mid], dur))
                     except Exception:
                         pass
-            if not evs:
-                continue
-            total = collections.Counter()
-            for name, d in evs:
-                # group by op family + dtype/shape
-                fam = re.match(r"%?([a-zA-Z_\-]+)", name)
-                k2 = fam.group(1) if fam else name
-                tm = re.search(r"= ((?:bf16|f32|s32|u32|s8|pred|u8)\[[^\]]*\])", name)
-                if tm: k2 += " " + tm.group(1)
-                total[k2] += d
-            print(f"-- line '{line_name}' on {plane_name}: {len(evs)} events, busy {sum(d for _, d in evs)/1e9:.2f} ms")
-            for nm, ps in total.most_common(topn):
-                print(f"  {ps/1e9:9.3f} ms  {nm[:95]}")
+            if evs:
+                yield plane_name, line_name, evs
 
-if len(sys.argv) < 2:
-    raise SystemExit(__doc__)
-topn = 15
-paths = sys.argv[1:]
-if len(paths) > 1 and paths[-1].isdigit():  # trailing topN after glob paths
-    topn = int(paths[-1])
-    paths = paths[:-1]
-for _p in paths:
-    if len(paths) > 1:
-        print(f"==== {_p}")
-    main(_p, topn)
+def xplane_lines(path):
+    """Library form: -> {line_name: (n_events, total_ms, fam, full)} where
+    ``fam`` maps op-family → ms and ``full`` maps full op name → ms.
+    Multi-chip captures are AGGREGATED across device planes (totals are the
+    sum over all cores)."""
+    out = {}
+    for plane_name, line_name, evs in iter_tpu_lines(path):
+        n0, t0, fam, full = out.setdefault(
+            line_name, (0, 0.0, collections.Counter(), collections.Counter()))
+        for name, d in evs:
+            m = re.match(r"%?([a-zA-Z_\-]+)", name)
+            fam[m.group(1) if m else name] += d / 1e9
+            full[name] += d / 1e9
+        out[line_name] = (n0 + len(evs),
+                          t0 + sum(d for _, d in evs) / 1e9, fam, full)
+    return out
+
+def main(path, topn=20):
+    for plane_name, line_name, evs in iter_tpu_lines(path):
+        total = collections.Counter()
+        for name, d in evs:
+            # group by op family + dtype/shape
+            fam = re.match(r"%?([a-zA-Z_\-]+)", name)
+            k2 = fam.group(1) if fam else name
+            tm = re.search(r"= ((?:bf16|f32|s32|u32|s8|pred|u8)\[[^\]]*\])", name)
+            if tm: k2 += " " + tm.group(1)
+            total[k2] += d
+        print(f"-- line '{line_name}' on {plane_name}: {len(evs)} events, busy {sum(d for _, d in evs)/1e9:.2f} ms")
+        for nm, ps in total.most_common(topn):
+            print(f"  {ps/1e9:9.3f} ms  {nm[:95]}")
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    topn = 15
+    paths = sys.argv[1:]
+    if len(paths) > 1 and paths[-1].isdigit():  # trailing topN after glob paths
+        topn = int(paths[-1])
+        paths = paths[:-1]
+    for _p in paths:
+        if len(paths) > 1:
+            print(f"==== {_p}")
+        main(_p, topn)

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Measure the DCE win from stop_gradient-ing frozen params (conv1 + bn1 +
+stage1 + all BN affines/stats — the reference's resnet fixed_param_prefix)
+in the ResNet-101 body fwd+bwd, vs the round-1 approach (grads computed for
+everything, zeroed in the optimizer)."""
+
+import glob
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parse_xplane import xplane_lines
+from mx_rcnn_tpu.models.backbones import ResNetConv
+from mx_rcnn_tpu.train.optim import fixed_param_mask
+
+assert jax.default_backend() == "tpu"
+
+H, W = 608, 1024
+REPEAT = 10
+
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(1, H // 2, W // 2, 12), jnp.float32)
+mod = ResNetConv(depth="resnet101")
+params = mod.init(jax.random.PRNGKey(0), x)["params"]
+
+# config.py resnet FIXED_PARAMS; fixed_param_mask joins path[1:], but here
+# the backbone IS the top level, so prepend a dummy root
+mask = fixed_param_mask({"backbone": params},
+                        ("conv1", "bn1", "stage1", "gamma", "beta"))["backbone"]
+n_frozen = sum(not m for m in jax.tree.leaves(mask))
+print(f"frozen leaves: {n_frozen}/{len(jax.tree.leaves(mask))}")
+
+
+def make_fwdbwd(stop_frozen):
+    def loss(p, x):
+        if stop_frozen:
+            p = jax.tree.map(
+                lambda v, t: v if t else jax.lax.stop_gradient(v), p, mask)
+        out = mod.apply({"params": p}, x)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def fwdbwd(p, x):
+        l, g = jax.value_and_grad(loss)(p, x)
+        return l + sum(jnp.sum(jnp.abs(t.astype(jnp.float32)))
+                       for t in jax.tree.leaves(g)) * 0.0
+
+    return fwdbwd
+
+
+for name, stop in (("mask-in-optimizer (round 1)", False),
+                   ("stop_gradient frozen (DCE)", True)):
+    fn = make_fwdbwd(stop)
+    for _ in range(3):
+        o = fn(params, x)
+    jax.block_until_ready(o)
+    d = f"/tmp/dce/{stop}"
+    shutil.rmtree(d, ignore_errors=True)
+    with jax.profiler.trace(d):
+        for _ in range(REPEAT):
+            o = fn(params, x)
+        jax.block_until_ready(o)
+    pb = glob.glob(f"{d}/plugins/profile/*/*.xplane.pb")[0]
+    mods = xplane_lines(pb).get("XLA Modules")
+    print(f"{name:32s} {mods[1] / REPEAT:7.3f} ms/call")

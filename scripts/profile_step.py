@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Profile the flagship bench train step (device time, per-op families)."""
+
+import glob
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import jax
+
+import bench
+from parse_xplane import main as print_xplane
+
+REPEAT = 10
+
+state, step, batch = bench.build()
+batch = jax.device_put(batch)
+key = jax.random.PRNGKey(7)
+
+for _ in range(3):
+    state, metrics = step(state, batch, key)
+jax.block_until_ready(metrics)
+
+d = "/tmp/prof_step"
+shutil.rmtree(d, ignore_errors=True)
+with jax.profiler.trace(d):
+    for _ in range(REPEAT):
+        state, metrics = step(state, batch, key)
+    jax.block_until_ready(metrics)
+
+pb = glob.glob(f"{d}/plugins/profile/*/*.xplane.pb")[0]
+print(f"(sums over {REPEAT} calls)")
+print_xplane(pb, topn=40)

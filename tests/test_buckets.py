@@ -32,8 +32,8 @@ def test_mixed_orientation_buckets_train():
     loader = AnchorLoader(roidb, cfg, batch_size=2, shuffle=False, seed=0)
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0), 2, (64, 96))
-    state, tx = create_train_state(cfg, params, steps_per_epoch=2)
-    step = make_train_step(model, tx)
+    state, tx, mask = create_train_state(cfg, params, steps_per_epoch=2)
+    step = make_train_step(model, tx, trainable_mask=mask)
 
     shapes = set()
     key = jax.random.PRNGKey(0)
@@ -67,8 +67,8 @@ def test_multi_scale_buckets_train():
                           seed=3)
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0), 2, (64, 96))
-    state, tx = create_train_state(cfg, params, steps_per_epoch=4)
-    step = make_train_step(model, tx)
+    state, tx, mask = create_train_state(cfg, params, steps_per_epoch=4)
+    step = make_train_step(model, tx, trainable_mask=mask)
 
     shapes = set()
     key = jax.random.PRNGKey(0)

@@ -127,7 +127,7 @@ def test_checkpoint_save_resume_roundtrip(tmp_path):
     cfg = tiny_cfg()
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
-    state, tx = create_train_state(cfg, params, steps_per_epoch=10)
+    state, tx, _ = create_train_state(cfg, params, steps_per_epoch=10)
     mgr = CheckpointManager(str(tmp_path / "ckpt"))
     mgr.save_epoch(1, state.params, cfg, opt_state=state.opt_state, step=7)
 
@@ -153,8 +153,8 @@ def test_sharded_train_step_updates_and_freezes():
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
     plan = make_mesh(data=8)
-    state, tx = create_train_state(cfg, params, steps_per_epoch=10)
-    step = make_train_step(model, tx, plan=plan)
+    state, tx, mask = create_train_state(cfg, params, steps_per_epoch=10)
+    step = make_train_step(model, tx, plan=plan, trainable_mask=mask)
 
     frozen_before = np.asarray(params["backbone"]["conv1"]["kernel"])
     train_before = np.asarray(params["rpn"]["rpn_conv_3x3"]["kernel"])
@@ -198,8 +198,8 @@ def test_multislice_mesh_matches_flat_dp():
     results = []
     for plan in (make_mesh(data=8),
                  make_multislice_mesh(slices=2, data_per_slice=4)):
-        state, tx = create_train_state(cfg, params, steps_per_epoch=10)
-        step = make_train_step(model, tx, plan=plan)
+        state, tx, mask = create_train_state(cfg, params, steps_per_epoch=10)
+        step = make_train_step(model, tx, plan=plan, trainable_mask=mask)
         state = jax.device_put(state, plan.replicated())
         for i in range(2):
             sb = shard_batch(plan, batch)
