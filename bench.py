@@ -1,11 +1,9 @@
-"""Benchmark: training throughput of the flagship config on the attached
-TPU chip.
+"""Benchmark: throughput of the flagship config on the attached TPU chip.
 
-Measures steady-state imgs/sec/chip of the jitted end-to-end train step
-(ResNet-101 Faster R-CNN, 608×1024 bucket — the BASELINE.json headline
-metric's throughput half; the accuracy half needs COCO on disk).
-
-Prints exactly ONE JSON line:
+Default (what the driver runs): steady-state imgs/sec/chip of the jitted
+end-to-end train step (ResNet-101 Faster R-CNN, 608×1024 bucket — the
+BASELINE.json headline metric's throughput half; the accuracy half needs
+COCO on disk), printed as exactly ONE JSON line:
   {"metric": "train_imgs_per_sec_per_chip", "value": N, "unit": "imgs/sec",
    "vs_baseline": R}
 
@@ -15,10 +13,22 @@ Prints exactly ONE JSON line:
 unrecoverable, see SURVEY §0).  Timing uses chained steps with a single
 final sync: on tunneled devices per-step host reads dominate (≫ step time)
 and block_until_ready acks early, so only amortized chains measure truth.
+
+Extra modes (manual, for BASELINE.md's scaling/honesty tables — each also
+prints one JSON line):
+  python bench.py --batch 4              # staged train step at B=4
+  python bench.py --mode loader          # loader-INCLUSIVE train: real
+      AnchorLoader over a synthetic roidb (cv2 resize, host s2d, prefetch
+      thread, per-step host→device transfer all in the measured loop — the
+      Speedometer-equivalent number)
+  python bench.py --mode infer --batch 4 # staged inference (predict chain)
+  python bench.py --mode infer-loader    # TestLoader + im_detect loop incl.
+      per-image host decode/readback (the test.py loop without class NMS)
 """
 
 from __future__ import annotations
 
+import argparse
 import dataclasses
 import json
 import os
@@ -30,58 +40,64 @@ import numpy as np
 REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_FILE = os.path.join(REPO, "BENCH_BASELINE.json")
 
-BATCH = 1
 H, W = 608, 1024
 WARMUP = 5
 STEPS = 30
 
 
-def build():
+def make_cfg():
     from mx_rcnn_tpu.config import generate_config
-    from mx_rcnn_tpu.models import build_model, init_params
-    from mx_rcnn_tpu.train import create_train_state, make_train_step
 
     cfg = generate_config("resnet101", "PascalVOC")
-    cfg = cfg.replace(network=dataclasses.replace(
+    return cfg.replace(network=dataclasses.replace(
         cfg.network, PIXEL_STDS=(127.0, 127.0, 127.0)))
-    model = build_model(cfg)
-    params = init_params(model, cfg, jax.random.PRNGKey(0), BATCH, (H, W))
-    state, tx, mask = create_train_state(cfg, params, steps_per_epoch=1000)
-    step = make_train_step(model, tx, trainable_mask=mask)
 
+
+def synthetic_batch(cfg, batch):
     rng = np.random.RandomState(0)
     g = cfg.tpu.MAX_GT
-    gtb = np.zeros((BATCH, g, 4), np.float32)
-    gtv = np.zeros((BATCH, g), bool)
-    gtc = np.zeros((BATCH, g), np.int32)
-    for b in range(BATCH):
+    gtb = np.zeros((batch, g, 4), np.float32)
+    gtv = np.zeros((batch, g), bool)
+    gtc = np.zeros((batch, g), np.int32)
+    for b in range(batch):
         for j in range(6):
             x1, y1 = rng.randint(0, W - 200), rng.randint(0, H - 200)
             gtb[b, j] = (x1, y1, x1 + rng.randint(60, 199),
                          y1 + rng.randint(60, 199))
             gtc[b, j] = rng.randint(1, 21)
             gtv[b, j] = True
-    images = rng.randn(BATCH, H, W, 3).astype(np.float32)
+    images = rng.randn(batch, H, W, 3).astype(np.float32)
     if cfg.network.HOST_S2D:  # ship images like the production loader does
         from mx_rcnn_tpu.data.image import space_to_depth2
 
         images = np.stack([space_to_depth2(im) for im in images])
-    batch = dict(
+    return dict(
         images=images,
-        im_info=np.tile(np.asarray([[H, W, 1.0]], np.float32), (BATCH, 1)),
+        im_info=np.tile(np.asarray([[H, W, 1.0]], np.float32), (batch, 1)),
         gt_boxes=gtb, gt_classes=gtc, gt_valid=gtv,
     )
-    return state, step, batch
 
 
-def main():
-    state, step, batch = build()
+def build(batch: int = 1):
+    from mx_rcnn_tpu.models import build_model, init_params
+    from mx_rcnn_tpu.train import create_train_state, make_train_step
+
+    cfg = make_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), batch, (H, W))
+    state, tx, mask = create_train_state(cfg, params, steps_per_epoch=1000)
+    step = make_train_step(model, tx, trainable_mask=mask)
+    return state, step, synthetic_batch(cfg, batch), cfg
+
+
+def bench_train_staged(batch: int):
+    state, step, hbatch, _ = build(batch)
     # stage the (constant) batch in HBM once: measuring per-step host->device
     # shipping would benchmark the tunnel, not the training step (real
     # training hides it behind the prefetcher's async device_put)
-    batch = jax.device_put(batch)
+    dbatch = jax.device_put(hbatch)
     for i in range(WARMUP):
-        state, m = step(state, batch, jax.random.PRNGKey(i))
+        state, m = step(state, dbatch, jax.random.PRNGKey(i))
     jax.block_until_ready(m)
     _ = float(jax.device_get(m["total_loss"]))  # full round-trip fence
 
@@ -89,28 +105,149 @@ def main():
     for _ in range(4):   # tunnel timing is noisy; best-of-4 chains
         t0 = time.time()
         for i in range(STEPS):
-            state, m = step(state, batch, jax.random.PRNGKey(i))
+            state, m = step(state, dbatch, jax.random.PRNGKey(i))
         _ = float(jax.device_get(m["total_loss"]))  # fence via real readback
         dt = (time.time() - t0) / STEPS
-        ips = BATCH / dt
-        best = ips if best is None else max(best, ips)
+        best = max(best or 0.0, batch / dt)
+    return best
 
-    if os.path.exists(BASELINE_FILE):
-        with open(BASELINE_FILE) as f:
-            base = json.load(f)["value"]
+
+def _synthetic_roidb(n=48):
+    from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+
+    return SyntheticDataset(num_images=n, height=600, width=800).gt_roidb()
+
+
+def bench_train_loader(batch: int):
+    """Loader-inclusive: cv2-free synthetic pixels, but the full production
+    path otherwise — resize to bucket, host s2d, target padding, prefetch
+    thread, host→device transfer, one jitted step per loader batch.
+
+    Best-of-4 fenced epochs, mirroring the staged bench's best-of-4 chains:
+    on the tunneled chip, a chain whose steps carry fresh host buffers
+    intermittently degrades to ~300 ms/call of transfer handshake (measured;
+    the same loop reruns at full speed) — an artifact of the remote-device
+    link, not of the loader, so worst-epoch numbers measure the tunnel."""
+    from mx_rcnn_tpu.data.loader import AnchorLoader
+
+    state, step, _, cfg = build(batch)
+    roidb = _synthetic_roidb()
+    loader = AnchorLoader(roidb, cfg, batch, shuffle=True, seed=0)
+    # warm the jit cache for every bucket the loader can emit
+    for b in loader:
+        state, m = step(state, b, jax.random.PRNGKey(0))
+    jax.block_until_ready(m)
+
+    best = None
+    for epoch in range(4):
+        imgs = 0
+        t0 = time.time()
+        for i, b in enumerate(loader):
+            state, m = step(state, b, jax.random.PRNGKey(i))
+            imgs += batch
+        _ = float(jax.device_get(m["total_loss"]))
+        best = max(best or 0.0, imgs / (time.time() - t0))
+    return best
+
+
+def build_infer(batch: int):
+    from mx_rcnn_tpu.eval.tester import Predictor
+    from mx_rcnn_tpu.models import build_model, init_params
+    from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
+
+    cfg = make_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), batch, (H, W))
+    params = denormalize_for_save(params, cfg)
+    return Predictor(model, params, cfg), cfg
+
+
+def bench_infer_staged(batch: int):
+    pred, cfg = build_infer(batch)
+    hbatch = synthetic_batch(cfg, batch)
+    images = jax.device_put(hbatch["images"])
+    im_info = jax.device_put(hbatch["im_info"])
+    for _ in range(WARMUP):
+        out = pred.predict(images, im_info)
+    jax.block_until_ready(out)
+
+    best = None
+    for _ in range(4):
+        t0 = time.time()
+        for _ in range(STEPS):
+            out = pred.predict(images, im_info)
+        _ = float(jax.device_get(out[2]).ravel()[0])  # readback fence
+        dt = (time.time() - t0) / STEPS
+        best = max(best or 0.0, batch / dt)
+    return best
+
+
+def bench_infer_loader(batch: int):
+    """The test.py loop: TestLoader (prefetching) + im_detect (device
+    forward + full readback + per-image host bbox decode).  Per-class NMS /
+    eval excluded — that is pred_eval's accounting, identical in the
+    reference."""
+    from mx_rcnn_tpu.data.loader import TestLoader
+    from mx_rcnn_tpu.eval.tester import im_detect
+
+    pred, cfg = build_infer(batch)
+    roidb = _synthetic_roidb()
+    loader = TestLoader(roidb, cfg, batch_size=batch)
+    for b in loader:   # warm all shapes
+        im_detect(pred, b)
+
+    best = None
+    for _ in range(4):   # best-of-4 epochs (see bench_train_loader note)
+        imgs = 0
+        t0 = time.time()
+        for b in loader:
+            dets = im_detect(pred, b)
+            imgs += len(dets)
+        best = max(best or 0.0, imgs / (time.time() - t0))
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="train",
+                    choices=["train", "loader", "infer", "infer-loader"])
+    ap.add_argument("--batch", type=int, default=1)
+    args = ap.parse_args()
+
+    if args.mode == "train":
+        value = bench_train_staged(args.batch)
+        metric = "train_imgs_per_sec_per_chip"
+    elif args.mode == "loader":
+        value = bench_train_loader(args.batch)
+        metric = "train_imgs_per_sec_loader_inclusive"
+    elif args.mode == "infer":
+        value = bench_infer_staged(args.batch)
+        metric = "infer_imgs_per_sec"
     else:
-        base = best
-        with open(BASELINE_FILE, "w") as f:
-            json.dump({"metric": "train_imgs_per_sec_per_chip", "value": best,
-                       "hardware": str(jax.devices()[0]),
-                       "config": "resnet101 faster-rcnn end2end 608x1024 b1"},
-                      f)
+        value = bench_infer_loader(args.batch)
+        metric = "infer_imgs_per_sec_loader_inclusive"
+    if args.batch != 1:
+        metric += f"_b{args.batch}"
+
+    vs = None
+    if args.mode == "train" and args.batch == 1:
+        if os.path.exists(BASELINE_FILE):
+            with open(BASELINE_FILE) as f:
+                base = json.load(f)["value"]
+        else:
+            base = value
+            with open(BASELINE_FILE, "w") as f:
+                json.dump({"metric": metric, "value": value,
+                           "hardware": str(jax.devices()[0]),
+                           "config": "resnet101 faster-rcnn end2end 608x1024 b1"},
+                          f)
+        vs = round(value / base, 3)
 
     print(json.dumps({
-        "metric": "train_imgs_per_sec_per_chip",
-        "value": round(best, 3),
+        "metric": metric,
+        "value": round(value, 3),
         "unit": "imgs/sec",
-        "vs_baseline": round(best / base, 3),
+        "vs_baseline": vs,
     }))
 
 

@@ -167,6 +167,12 @@ def nms_pallas(boxes: jnp.ndarray, scores: jnp.ndarray, max_out: int,
     return contract: (keep_idx (max_out,) i32, keep_mask (max_out,) bool),
     selection order score-descending given score-sorted input).
 
+    vmap-safe: batched callers (the detector vmaps ``propose`` over images)
+    hit a ``custom_vmap`` rule that lowers to ``lax.map`` over single-image
+    kernel calls — Mosaic cannot lower auto-batched SMEM block specs (a
+    squeezed leading dim violates the (8, 128) block-shape rule), and the
+    sweep is sequential per image anyway.
+
     On non-TPU backends (the CPU test mesh) this delegates to the pure-JAX
     oracle — Mosaic kernels only lower on TPU; kernel-vs-oracle equivalence
     runs on the real chip (scripts/check_pallas.py, and bench exercises it
@@ -178,15 +184,46 @@ def nms_pallas(boxes: jnp.ndarray, scores: jnp.ndarray, max_out: int,
         return nms_padded(boxes, scores, max_out=max_out,
                           iou_thresh=iou_thresh, valid=valid)
     n = boxes.shape[0]
+    if valid is None:
+        valid = jnp.ones((n,), bool)
+    return _nms_vmappable(max_out, iou_thresh)(boxes, scores, valid)
+
+
+def _nms_vmappable(max_out: int, iou_thresh: float):
+    fn = _VMAP_CACHE.get((max_out, iou_thresh))
+    if fn is not None:
+        return fn
+
+    @jax.custom_batching.custom_vmap
+    def fn(boxes, scores, valid):
+        return _nms_core(boxes, scores, valid, max_out, iou_thresh)
+
+    @fn.def_vmap
+    def _rule(axis_size, in_batched, boxes, scores, valid):
+        args = [
+            a if b else jnp.broadcast_to(a[None], (axis_size,) + a.shape)
+            for a, b in zip((boxes, scores, valid), in_batched)
+        ]
+        out = jax.lax.map(lambda t: fn(*t), tuple(args))
+        return out, (True, True)
+
+    _VMAP_CACHE[(max_out, iou_thresh)] = fn
+    return fn
+
+
+_VMAP_CACHE: dict = {}
+
+
+def _nms_core(boxes: jnp.ndarray, scores: jnp.ndarray, valid: jnp.ndarray,
+              max_out: int, iou_thresh: float):
+    del scores  # selection order is index order (callers pass sorted boxes)
+    n = boxes.shape[0]
     n_pad = _pad_to(n, _PAD)   # (n_pad/_PL) lane-aligned, divisible by _BR
     w32 = n_pad // _PL
 
     boxes_p = jnp.zeros((n_pad, 4), jnp.float32).at[:n].set(
         boxes.astype(jnp.float32))
-    if valid is None:
-        valid_p = (jnp.arange(n_pad) < n)
-    else:
-        valid_p = jnp.zeros((n_pad,), bool).at[:n].set(valid)
+    valid_p = jnp.zeros((n_pad,), bool).at[:n].set(valid)
 
     # column boxes regrouped so bit-lane j of the pack loop reads columns
     # {32w + j} as a contiguous row: (4, W32, 32) -> (4, 32, W32)

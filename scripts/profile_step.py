@@ -16,7 +16,7 @@ from parse_xplane import main as print_xplane
 
 REPEAT = 10
 
-state, step, batch = bench.build()
+state, step, batch, _ = bench.build()
 batch = jax.device_put(batch)
 key = jax.random.PRNGKey(7)
 
