@@ -45,10 +45,10 @@ WARMUP = 5
 STEPS = 30
 
 
-def make_cfg():
+def make_cfg(network: str = "resnet101"):
     from mx_rcnn_tpu.config import generate_config
 
-    cfg = generate_config("resnet101", "PascalVOC")
+    cfg = generate_config(network, "PascalVOC")
     return cfg.replace(network=dataclasses.replace(
         cfg.network, PIXEL_STDS=(127.0, 127.0, 127.0)))
 
@@ -71,18 +71,24 @@ def synthetic_batch(cfg, batch):
         from mx_rcnn_tpu.data.image import space_to_depth2
 
         images = np.stack([space_to_depth2(im) for im in images])
-    return dict(
+    out = dict(
         images=images,
         im_info=np.tile(np.asarray([[H, W, 1.0]], np.float32), (batch, 1)),
         gt_boxes=gtb, gt_classes=gtc, gt_valid=gtv,
     )
+    if cfg.network.HAS_MASK:
+        from mx_rcnn_tpu.data.mask import GT_MASK_SIZE
+
+        out["gt_masks"] = np.ones((batch, g, GT_MASK_SIZE, GT_MASK_SIZE),
+                                  np.float32)
+    return out
 
 
-def build(batch: int = 1):
+def build(batch: int = 1, network: str = "resnet101"):
     from mx_rcnn_tpu.models import build_model, init_params
     from mx_rcnn_tpu.train import create_train_state, make_train_step
 
-    cfg = make_cfg()
+    cfg = make_cfg(network)
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0), batch, (H, W))
     state, tx, mask = create_train_state(cfg, params, steps_per_epoch=1000)
@@ -90,8 +96,8 @@ def build(batch: int = 1):
     return state, step, synthetic_batch(cfg, batch), cfg
 
 
-def bench_train_staged(batch: int):
-    state, step, hbatch, _ = build(batch)
+def bench_train_staged(batch: int, network: str = "resnet101"):
+    state, step, hbatch, _ = build(batch, network)
     # stage the (constant) batch in HBM once: measuring per-step host->device
     # shipping would benchmark the tunnel, not the training step (real
     # training hides it behind the prefetcher's async device_put)
@@ -118,7 +124,7 @@ def _synthetic_roidb(n=48):
     return SyntheticDataset(num_images=n, height=600, width=800).gt_roidb()
 
 
-def bench_train_loader(batch: int):
+def bench_train_loader(batch: int, network: str = "resnet101"):
     """Loader-inclusive: cv2-free synthetic pixels, but the full production
     path otherwise — resize to bucket, host s2d, target padding, prefetch
     thread, host→device transfer, one jitted step per loader batch.
@@ -130,7 +136,7 @@ def bench_train_loader(batch: int):
     link, not of the loader, so worst-epoch numbers measure the tunnel."""
     from mx_rcnn_tpu.data.loader import AnchorLoader
 
-    state, step, _, cfg = build(batch)
+    state, step, _, cfg = build(batch, network)
     roidb = _synthetic_roidb()
     loader = AnchorLoader(roidb, cfg, batch, shuffle=True, seed=0)
     # warm the jit cache for every bucket the loader can emit
@@ -150,20 +156,20 @@ def bench_train_loader(batch: int):
     return best
 
 
-def build_infer(batch: int):
+def build_infer(batch: int, network: str = "resnet101"):
     from mx_rcnn_tpu.eval.tester import Predictor
     from mx_rcnn_tpu.models import build_model, init_params
     from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
 
-    cfg = make_cfg()
+    cfg = make_cfg(network)
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0), batch, (H, W))
     params = denormalize_for_save(params, cfg)
     return Predictor(model, params, cfg), cfg
 
 
-def bench_infer_staged(batch: int):
-    pred, cfg = build_infer(batch)
+def bench_infer_staged(batch: int, network: str = "resnet101"):
+    pred, cfg = build_infer(batch, network)
     hbatch = synthetic_batch(cfg, batch)
     images = jax.device_put(hbatch["images"])
     im_info = jax.device_put(hbatch["im_info"])
@@ -182,7 +188,7 @@ def bench_infer_staged(batch: int):
     return best
 
 
-def bench_infer_loader(batch: int):
+def bench_infer_loader(batch: int, network: str = "resnet101"):
     """The test.py loop: TestLoader (prefetching) + im_detect (device
     forward + full readback + per-image host bbox decode).  Per-class NMS /
     eval excluded — that is pred_eval's accounting, identical in the
@@ -190,7 +196,7 @@ def bench_infer_loader(batch: int):
     from mx_rcnn_tpu.data.loader import TestLoader
     from mx_rcnn_tpu.eval.tester import im_detect
 
-    pred, cfg = build_infer(batch)
+    pred, cfg = build_infer(batch, network)
     roidb = _synthetic_roidb()
     loader = TestLoader(roidb, cfg, batch_size=batch)
     for b in loader:   # warm all shapes
@@ -212,25 +218,31 @@ def main():
     ap.add_argument("--mode", default="train",
                     choices=["train", "loader", "infer", "infer-loader"])
     ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--network", default="resnet101",
+                    help="config preset (e.g. resnet101, resnet101_fpn, "
+                         "resnet101_fpn_mask); non-default appears in the "
+                         "metric name")
     args = ap.parse_args()
 
     if args.mode == "train":
-        value = bench_train_staged(args.batch)
+        value = bench_train_staged(args.batch, args.network)
         metric = "train_imgs_per_sec_per_chip"
     elif args.mode == "loader":
-        value = bench_train_loader(args.batch)
+        value = bench_train_loader(args.batch, args.network)
         metric = "train_imgs_per_sec_loader_inclusive"
     elif args.mode == "infer":
-        value = bench_infer_staged(args.batch)
+        value = bench_infer_staged(args.batch, args.network)
         metric = "infer_imgs_per_sec"
     else:
-        value = bench_infer_loader(args.batch)
+        value = bench_infer_loader(args.batch, args.network)
         metric = "infer_imgs_per_sec_loader_inclusive"
     if args.batch != 1:
         metric += f"_b{args.batch}"
+    if args.network != "resnet101":
+        metric += f"_{args.network}"
 
     vs = None
-    if args.mode == "train" and args.batch == 1:
+    if args.mode == "train" and args.batch == 1 and args.network == "resnet101":
         if os.path.exists(BASELINE_FILE):
             with open(BASELINE_FILE) as f:
                 base = json.load(f)["value"]

@@ -29,17 +29,25 @@ import jax.numpy as jnp
 from mx_rcnn_tpu.ops.boxes import bbox_overlaps, bbox_transform
 
 
-def _keep_topk_random(mask: jnp.ndarray, k, key) -> jnp.ndarray:
+def _keep_topk_random(mask: jnp.ndarray, k, key, k_cap: int) -> jnp.ndarray:
     """Keep at most k True entries of ``mask``, chosen uniformly.
 
-    Deterministic given the key: ranks a uniform priority and keeps the top-k
-    ranked True entries. k may be a traced scalar.
+    Deterministic given the key: draws a uniform priority per entry and
+    keeps the top-k priorities among True entries.  ``k`` may be a traced
+    scalar; ``k_cap`` is its static upper bound (the subsample quota).
+    Implemented as ``lax.top_k(k_cap)`` + a k-limited scatter of the winner
+    indices — a full argsort-rank costs 4 (1, N) sorts per assign at FPN's
+    155k concatenated anchors (~6.8 ms/step profiled on v5-lite) where the
+    static-k top_k is ~0.2 ms, and top_k's break-ties-by-index keeps the
+    ≤ k contract exact (a float-tie at the threshold would not).
     """
+    k_cap = min(k_cap, mask.shape[-1])  # quotas can exceed the anchor count
     r = jax.random.uniform(key, mask.shape)
     r = jnp.where(mask, r, -1.0)
-    # rank[i] = position of i in descending-priority order
-    rank = jnp.argsort(jnp.argsort(-r))
-    return mask & (rank < k)
+    _, idx = jax.lax.top_k(r, k_cap)
+    sel = jnp.arange(k_cap) < k
+    keep = jnp.zeros(mask.shape, bool).at[idx].set(sel)
+    return keep & mask
 
 
 @partial(jax.jit, static_argnames=("batch_size", "fg_fraction",
@@ -111,9 +119,9 @@ def assign_anchor(
 
     # subsample
     k_fg, k_bg = jax.random.split(key)
-    fg_kept = _keep_topk_random(fg, num_fg_cap, k_fg)
+    fg_kept = _keep_topk_random(fg, num_fg_cap, k_fg, num_fg_cap)
     num_fg = jnp.sum(fg_kept)
-    bg_kept = _keep_topk_random(bg, batch_size - num_fg, k_bg)
+    bg_kept = _keep_topk_random(bg, batch_size - num_fg, k_bg, batch_size)
 
     label = jnp.full((n,), -1, dtype=jnp.int32)
     label = jnp.where(bg_kept, 0, label)

@@ -127,8 +127,13 @@ def propose_fpn(
         scores = jnp.where((ws >= ms) & (hs >= ms), scores, -1.0)
         k = min(k_level, scores.shape[0])
         # argsort instead of lax.top_k: the v5e compiler SIGABRTs on top_k
-        # fused into the full FPN pyramid graph (verified: top_k alone and
-        # the standalone propose compile; only the fused graph crashes)
+        # fused into the full FPN pyramid graph.  Re-verified round 2
+        # (2026-07-30, jax 0.9.0): `F fusion_util.cc:3726 Check failed:
+        # chunk_counts[new_window_dim] == 1 ... TransformWindow: Loop will
+        # not make progress ... f32[1,116736,1]` → SIGABRT.  top_k alone
+        # and the standalone propose compile fine; only the fused pyramid
+        # graph crashes — an XLA:TPU fusion-pass bug, fenced here.  The
+        # argsort costs ~1.0 ms at P2 (profiled); retry on jax upgrades.
         top_idx = jnp.argsort(-scores)[:k]
         cand_boxes.append(boxes[top_idx])
         cand_scores.append(scores[top_idx])
