@@ -82,7 +82,13 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     n = data * model
     if n > len(devices):
         raise ValueError(f"mesh {data}x{model} needs {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(data, model)
+    if n < len(devices):
+        # same contract as make_multislice_mesh: an explicit smaller mesh
+        # must not silently idle chips — slice the device list yourself
+        raise ValueError(
+            f"mesh {data}x{model} uses only {n} of {len(devices)} devices; "
+            "pass devices[:n] explicitly if that is intended")
+    arr = np.asarray(devices).reshape(data, model)
     return MeshPlan(mesh=Mesh(arr, axis_names))
 
 
@@ -141,8 +147,14 @@ def make_multislice_mesh(devices: Optional[Sequence[jax.Device]] = None,
     if n > per:
         raise ValueError(f"slice mesh {data_per_slice}x{model} needs {n} "
                          f"devices per slice, have {per}")
-    arr = np.asarray([g[:n] for g in groups]).reshape(
-        slices, data_per_slice, model)
+    if n < per:
+        # mirrors the uneven-slice error above: an explicit data_per_slice
+        # smaller than the slice must not silently idle chips
+        raise ValueError(
+            f"slice mesh {data_per_slice}x{model} uses only {n} of {per} "
+            "devices per slice; pass an explicit device subset if that is "
+            "intended")
+    arr = np.asarray(groups).reshape(slices, data_per_slice, model)
     return MeshPlan(mesh=Mesh(arr, ("dcn", "data", "model")))
 
 

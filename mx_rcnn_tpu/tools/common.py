@@ -40,6 +40,12 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
     parser.add_argument("--synthetic", action="store_true",
                         help="use the synthetic dataset (no files needed)")
     parser.add_argument("--synthetic_images", type=int, default=64)
+    parser.add_argument("--cfg", action="append", default=[],
+                        metavar="PATH=VALUE",
+                        help="config override, repeatable; double-underscore "
+                             "paths into the tree with python-literal values "
+                             "(e.g. --cfg tpu__SCALES='((64,96),)' "
+                             "--cfg TRAIN__BATCH_ROIS=32)")
     if train:
         parser.add_argument("--pretrained", default="",
                             help=".npz backbone/params path (converted)")
@@ -67,6 +73,19 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
 
 def config_from_args(args, train: bool = True) -> Config:
     overrides = {}
+    import ast
+
+    for item in getattr(args, "cfg", []) or []:
+        key, _, val = item.partition("=")
+        if not _:
+            raise ValueError(f"--cfg expects PATH=VALUE, got '{item}'")
+        try:
+            overrides[key] = ast.literal_eval(val)
+        except (ValueError, SyntaxError) as e:
+            raise ValueError(
+                f"--cfg {key}: value {val!r} is not a python literal "
+                f"(strings need quotes, e.g. --cfg dataset__IMAGE_SET="
+                f"'\"2007_trainval\"'): {e}") from None
     if train:
         if args.lr is not None:
             overrides["TRAIN__LR"] = args.lr

@@ -68,6 +68,25 @@ for name, (b, s, mo, t, v) in {
     if km_p.sum() != km_r.sum() or not np.array_equal(ki_p[km_p], ki_r[km_r]):
         fails += 1
         print(f"MISMATCH [{name}]: kept {km_p.sum()} vs {km_r.sum()}")
+# batched path (vmap over images — the detector's B>1 shape; exercises the
+# custom_vmap → lax.map rule, which Mosaic can't auto-batch)
+bb = jnp.stack([gen(2048, s)[0] for s in range(3)])
+ss = jnp.stack([gen(2048, s)[1] for s in range(3)])
+# per-image DIFFERENT invalid holes: a batching-rule regression that drops
+# or broadcasts the valid mask must fail this, not just the all-True case
+vv = jnp.stack([jnp.asarray(np.random.RandomState(100 + s).rand(2048) > 0.05)
+                for s in range(3)])
+ki_b, km_b = jax.device_get(jax.vmap(
+    lambda b, s, v: nms_pallas(b, s, max_out=300, iou_thresh=0.7, valid=v)
+)(bb, ss, vv))
+for b in range(3):
+    ki_r, km_r = jax.device_get(nms_padded(bb[b], ss[b], max_out=300,
+                                           iou_thresh=0.7, valid=vv[b]))
+    if km_b[b].sum() != km_r.sum() or not np.array_equal(
+            ki_b[b][km_b[b]], ki_r[km_r]):
+        fails += 1
+        print(f"MISMATCH [vmap b={b}]: kept {km_b[b].sum()} vs {km_r.sum()}")
+
 print("equivalence:", "FAIL" if fails else "OK")
 
 # timing (chained, fence by readback)

@@ -34,3 +34,9 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: drives the real TPU chip via a subprocess "
+        "(auto-skips when no chip is attached)")

@@ -1,0 +1,129 @@
+"""On-disk dataset fixtures: a mini-VOCdevkit and a mini-COCO, generated
+from synthetic learnable images (solid class-colored rectangles on noise —
+the SyntheticDataset recipe, but written through the real file formats).
+
+These exist so the ACTUAL file pipelines run under test: cv2/PIL JPEG
+decode → resize/bucket → train → checkpoint → eval → official writeout
+(VERDICT round-1 item 2: rehearse the real-data path end-to-end through
+files so the day VOC/COCO appears nothing new can break).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+# three visually distinct classes; names must be real VOC classes so the
+# PascalVOC name→index mapping applies unchanged
+FIXTURE_CLASSES = ("aeroplane", "bicycle", "bird")
+_COLORS = {"aeroplane": (220, 40, 40), "bicycle": (40, 220, 40),
+           "bird": (40, 40, 220)}
+
+
+def _make_image(rng, h, w, max_objects=3):
+    """-> (uint8 RGB image, [(name, x1, y1, x2, y2)])."""
+    img = (rng.randn(h, w, 3) * 12 + 127).clip(0, 255).astype(np.uint8)
+    n = rng.randint(1, max_objects + 1)
+    objs = []
+    for _ in range(n):
+        name = FIXTURE_CLASSES[rng.randint(len(FIXTURE_CLASSES))]
+        bw = rng.randint(w // 4, w // 2)
+        bh = rng.randint(h // 4, h // 2)
+        x1 = rng.randint(0, w - bw)
+        y1 = rng.randint(0, h - bh)
+        img[y1:y1 + bh, x1:x1 + bw] = _COLORS[name]
+        objs.append((name, x1, y1, x1 + bw - 1, y1 + bh - 1))
+    return img, objs
+
+
+def _save_jpeg(path, img):
+    from PIL import Image
+
+    Image.fromarray(img).save(path, quality=95)
+
+
+def make_mini_voc(dataset_path: str, n_train: int = 16, n_test: int = 8,
+                  size=(120, 160), year: str = "2007", seed: int = 0):
+    """Write a mini VOCdevkit under ``dataset_path`` (JPEGImages +
+    Annotations + ImageSets/Main/{trainval,test}.txt).  Returns
+    (train_ids, test_ids)."""
+    rng = np.random.RandomState(seed)
+    h, w = size
+    devkit = os.path.join(dataset_path, f"VOC{year}")
+    for sub in ("JPEGImages", "Annotations", os.path.join("ImageSets", "Main")):
+        os.makedirs(os.path.join(devkit, sub), exist_ok=True)
+
+    splits = {"trainval": [f"{i:06d}" for i in range(n_train)],
+              "test": [f"{1000 + i:06d}" for i in range(n_test)]}
+    for split, ids in splits.items():
+        with open(os.path.join(devkit, "ImageSets", "Main", split + ".txt"),
+                  "w") as f:
+            f.write("\n".join(ids) + "\n")
+        for idx in ids:
+            img, objs = _make_image(rng, h, w)
+            _save_jpeg(os.path.join(devkit, "JPEGImages", idx + ".jpg"), img)
+            xml = [f"<annotation><filename>{idx}.jpg</filename>",
+                   f"<size><width>{w}</width><height>{h}</height>"
+                   "<depth>3</depth></size>"]
+            for name, x1, y1, x2, y2 in objs:
+                # VOC pixels are 1-indexed in the XML
+                xml.append(
+                    f"<object><name>{name}</name><difficult>0</difficult>"
+                    f"<bndbox><xmin>{x1 + 1}</xmin><ymin>{y1 + 1}</ymin>"
+                    f"<xmax>{x2 + 1}</xmax><ymax>{y2 + 1}</ymax></bndbox>"
+                    "</object>")
+            xml.append("</annotation>")
+            with open(os.path.join(devkit, "Annotations", idx + ".xml"),
+                      "w") as f:
+                f.write("\n".join(xml))
+    return splits["trainval"], splits["test"]
+
+
+def make_mini_coco(dataset_path: str, image_set: str = "minitrain",
+                   n: int = 12, size=(120, 160), seed: int = 0,
+                   with_masks: bool = True):
+    """Write a mini COCO split: ``{dataset_path}/{image_set}/*.jpg`` +
+    ``{dataset_path}/annotations/instances_{image_set}.json`` (sparse
+    category ids, polygon segmentations covering the boxes)."""
+    rng = np.random.RandomState(seed)
+    h, w = size
+    img_dir = os.path.join(dataset_path, image_set)
+    os.makedirs(img_dir, exist_ok=True)
+    os.makedirs(os.path.join(dataset_path, "annotations"), exist_ok=True)
+
+    # sparse ids on purpose (the real COCO ids are sparse)
+    categories = [{"id": 3 * i + 1, "name": n_}
+                  for i, n_ in enumerate(FIXTURE_CLASSES)]
+    name_to_cat = {c["name"]: c["id"] for c in categories}
+
+    images, annotations = [], []
+    aid = 1
+    for i in range(n):
+        img, objs = _make_image(rng, h, w)
+        fname = f"{i:012d}.jpg"
+        _save_jpeg(os.path.join(img_dir, fname), img)
+        images.append({"id": i + 1, "file_name": fname,
+                       "height": h, "width": w})
+        for name, x1, y1, x2, y2 in objs:
+            bw = x2 - x1 + 1
+            bh = y2 - y1 + 1
+            ann = {"id": aid, "image_id": i + 1,
+                   "category_id": name_to_cat[name],
+                   "bbox": [float(x1), float(y1), float(bw), float(bh)],
+                   "area": float(bw * bh), "iscrowd": 0}
+            if with_masks:
+                ann["segmentation"] = [[float(x1), float(y1), float(x2 + 1),
+                                        float(y1), float(x2 + 1),
+                                        float(y2 + 1), float(x1),
+                                        float(y2 + 1)]]
+            annotations.append(ann)
+            aid += 1
+
+    path = os.path.join(dataset_path, "annotations",
+                        f"instances_{image_set}.json")
+    with open(path, "w") as f:
+        json.dump({"images": images, "annotations": annotations,
+                   "categories": categories}, f)
+    return path

@@ -1,0 +1,157 @@
+"""pred_eval edge cases (VERDICT round-1 item 8): the max_per_image cap
+under score ties at the threshold boundary, and the mask chunk-drain loop
+when detections exceed the static chunk size R.  Driven through the REAL
+``pred_eval`` loop with a stub predictor whose outputs are hand-crafted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.eval.tester import pred_eval
+
+
+class StubPredictor:
+    """Emits R fixed, well-separated boxes per image with crafted
+    per-class scores; optionally a mask branch with call accounting."""
+
+    def __init__(self, cfg, scores, boxes):
+        self.cfg = cfg
+        self._scores = scores            # (B, R, K)
+        self._boxes = boxes              # (B, R, 4)
+        self.mask_calls = 0
+        self._feats = object()
+
+    def predict(self, images, im_info):
+        B, R, K = self._scores.shape
+        rois = jnp.asarray(self._boxes)
+        deltas = jnp.zeros((B, R, 4 * K), jnp.float32)  # identity decode
+        return (rois, jnp.ones((B, R), bool), jnp.asarray(self._scores),
+                deltas, None)
+
+    def predict_masks_cached(self, boxes, labels):
+        self.mask_calls += 1
+        B, R = labels.shape
+        return np.full((B, R, 28, 28), 0.9, np.float32)
+
+
+class StubLoader:
+    def __init__(self, batch, roidb):
+        self._batch = batch
+        self.roidb = roidb
+
+    def __iter__(self):
+        return iter([self._batch])
+
+
+class RecordingIMDB:
+    """Captures what pred_eval hands to evaluation."""
+
+    def __init__(self, num_classes, num_images, with_sds=False):
+        self.num_classes = num_classes
+        self.num_images = num_images
+        self.captured = {}
+        if with_sds:
+            self.evaluate_sds = self._evaluate_sds
+
+    def evaluate_detections(self, all_boxes):
+        self.captured["boxes"] = all_boxes
+        return {"mAP": 0.0}
+
+    def _evaluate_sds(self, all_boxes, all_masks):
+        self.captured["boxes"] = all_boxes
+        self.captured["masks"] = all_masks
+        return {"bbox": {"mAP": 0.0}}
+
+
+def _setup(num_classes=3, R=12, B=1, H=64, W=96, mask=False):
+    cfg = generate_config("resnet101_fpn_mask" if mask else "resnet101",
+                          "PascalVOC")
+    batch = dict(
+        images=np.zeros((B, H, W, 3), np.float32),
+        im_info=np.tile(np.asarray([[H, W, 1.0]], np.float32), (B, 1)),
+        indices=np.arange(B, dtype=np.int32),
+        batch_valid=np.ones((B,), bool),
+    )
+    # R well-separated 8x8 boxes on a grid: NMS at 0.3 keeps all of them
+    boxes = np.zeros((B, R, 4), np.float32)
+    for r in range(R):
+        x, y = 10 * (r % 6), 20 * (r // 6)
+        boxes[:, r] = (x, y, x + 8, y + 8)
+    roidb = [{"height": H, "width": W} for _ in range(B)]
+    return cfg, batch, boxes, roidb
+
+
+def test_max_per_image_cap_keeps_threshold_ties():
+    """12 detections, cap 4.  Scores: two at 0.9, then SIX tied exactly at
+    0.5, rest at 0.2.  The cap threshold is the 4th-highest score (0.5);
+    the reference keeps every det >= threshold, so ALL six ties survive
+    → 8 detections, not 4.  (Reference semantics: tester.py max_per_image
+    block uses >=; silently truncating ties would be a behavior change.)"""
+    cfg, batch, boxes, roidb = _setup()
+    K = 3
+    scores = np.zeros((1, 12, K), np.float32)
+    scores[0, :, 0] = 1.0  # background column, ignored
+    fg = np.array([0.9, 0.9, 0.5, 0.5, 0.5, 0.5, 0.5, 0.5, 0.2, 0.2, 0.2,
+                   0.2], np.float32)
+    scores[0, :, 1] = fg
+    imdb = RecordingIMDB(num_classes=K, num_images=1)
+    pred = StubPredictor(cfg, scores, boxes)
+    pred_eval(pred, StubLoader(batch, roidb), imdb, max_per_image=4,
+              thresh=0.05)
+    kept = imdb.captured["boxes"][1][0]
+    assert len(kept) == 8, kept[:, 4]
+    assert (kept[:, 4] >= 0.5).all()
+    # and class 2 (no dets above threshold after cap) is an empty array,
+    # not None
+    assert len(imdb.captured["boxes"][2][0]) == 0
+
+
+def test_max_per_image_cap_across_classes():
+    """The cap pools scores across classes before thresholding (reference:
+    np.sort over the hstack of all classes' scores)."""
+    cfg, batch, boxes, roidb = _setup()
+    K = 3
+    scores = np.zeros((1, 12, K), np.float32)
+    scores[0, :6, 1] = [0.9, 0.8, 0.7, 0.2, 0.15, 0.1]
+    scores[0, 6:, 2] = [0.85, 0.75, 0.3, 0.12, 0.11, 0.1]
+    imdb = RecordingIMDB(num_classes=K, num_images=1)
+    pred_eval(StubPredictor(cfg, scores, boxes), StubLoader(batch, roidb),
+              imdb, max_per_image=4, thresh=0.05)
+    c1 = imdb.captured["boxes"][1][0][:, 4]
+    c2 = imdb.captured["boxes"][2][0][:, 4]
+    # top-4 pooled = {0.9, 0.85, 0.8, 0.75} → 2 from each class
+    assert len(c1) == 2 and len(c2) == 2
+    np.testing.assert_allclose(
+        np.sort(np.concatenate([c1, c2])), [0.75, 0.8, 0.85, 0.9], atol=1e-6)
+
+
+def test_mask_chunk_drain_exceeds_chunk():
+    """Mask pass with cap 4 but 10 surviving detections per image: the
+    static chunk is R=4, so the drain loop must run 3 passes and every
+    detection row must get an RLE (no silent drops)."""
+    cfg, batch, boxes, roidb = _setup(mask=True)
+    K = 3
+    scores = np.zeros((1, 12, K), np.float32)
+    # ten tied scores at 0.5 → cap threshold 0.5 keeps all ten (tie rule)
+    scores[0, :10, 1] = 0.5
+    imdb = RecordingIMDB(num_classes=K, num_images=1, with_sds=True)
+    pred = StubPredictor(cfg, scores, boxes)
+    stats = pred_eval(pred, StubLoader(batch, roidb), imdb, max_per_image=4,
+                      thresh=0.05, with_masks=True)
+    assert "bbox" in stats
+    kept = imdb.captured["boxes"][1][0]
+    masks = imdb.captured["masks"][1][0]
+    assert len(kept) == 10
+    assert len(masks) == 10 and all(m is not None for m in masks)
+    assert pred.mask_calls == 3  # ceil(10 / 4) chunks
+    # RLE decodes back to a mask covering the box area
+    from mx_rcnn_tpu.eval.mask_rle import decode
+
+    m0 = decode(masks[0])
+    assert m0.shape == (64, 96)
+    assert m0.sum() > 0

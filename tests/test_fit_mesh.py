@@ -1,0 +1,69 @@
+"""fit() on the 8-device CPU mesh with a REAL AnchorLoader (VERDICT
+round-1 item 7): the loader × data-parallel seam — shard_batch on loader
+output, per-bucket compiled programs under one fit loop, and the
+wrap-padded epoch tail — none of which the step-level mesh tests touch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import AnchorLoader, SyntheticDataset
+from mx_rcnn_tpu.models import build_model, init_params
+from mx_rcnn_tpu.parallel import make_mesh
+from mx_rcnn_tpu.train import fit
+
+
+def mesh_cfg():
+    cfg = generate_config(
+        "resnet50", "PascalVOC",
+        TRAIN__RPN_PRE_NMS_TOP_N=200, TRAIN__RPN_POST_NMS_TOP_N=32,
+        TRAIN__BATCH_ROIS=16, TRAIN__FLIP=False,
+    )
+    net = dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4),
+                              PIXEL_STDS=(127.0, 127.0, 127.0))
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=4)
+    return cfg.replace(network=net, tpu=tpu)
+
+
+def test_fit_loader_on_mesh():
+    """Global batch 8 over 8 devices, mixed-orientation roidb (landscape +
+    portrait → TWO bucket programs inside one fit), epoch not divisible by
+    the batch (wrap-padded tail batch)."""
+    cfg = mesh_cfg()
+    # 10 landscape + 6 portrait images: neither bucket divides batch 8, so
+    # both epoch tails wrap; orientations land in different buckets
+    land = SyntheticDataset(num_images=10, num_classes=cfg.NUM_CLASSES,
+                            height=64, width=96, seed=0).gt_roidb()
+    port = SyntheticDataset(num_images=6, num_classes=cfg.NUM_CLASSES,
+                            height=96, width=64, seed=1).gt_roidb()
+    roidb = land + port
+    loader = AnchorLoader(roidb, cfg, batch_size=8, shuffle=True, seed=0)
+
+    # the loader must actually emit both bucket shapes (the per-bucket
+    # program seam this test exists for)
+    shapes = {b["images"].shape[1:3] for b in loader}
+    assert len(shapes) == 2, shapes
+
+    plan = make_mesh(data=8)
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    before = np.asarray(params["rpn"]["rpn_conv_3x3"]["kernel"]).copy()
+    frozen_before = np.asarray(params["backbone"]["conv1"]["kernel"]).copy()
+
+    state = fit(cfg, model, params, loader, begin_epoch=0, end_epoch=2,
+                plan=plan, frequent=1)
+
+    got = jax.device_get(state.params)
+    after = np.asarray(got["rpn"]["rpn_conv_3x3"]["kernel"])
+    assert np.isfinite(after).all()
+    assert not np.allclose(after, before), "trainable params did not move"
+    np.testing.assert_array_equal(
+        np.asarray(got["backbone"]["conv1"]["kernel"]), frozen_before)
+    # both epochs' steps ran: 2 buckets × ceil(10/8 + 6/8) = 2 + 1 = 3
+    # steps/epoch × 2 epochs
+    assert int(jax.device_get(state.step)) == 6

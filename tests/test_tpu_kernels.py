@@ -1,0 +1,44 @@
+"""On-chip Pallas kernel gate (VERDICT round-1 item 5).
+
+The pytest suite itself runs on the forced CPU mesh (tests/conftest.py),
+where ``nms_pallas`` silently delegates to the pure-JAX oracle — a Mosaic
+kernel regression would be invisible to every other test.  This module
+closes that hole: it runs ``scripts/check_pallas.py`` (kernel-vs-oracle
+equivalence across shapes, adversarial structures, and the batched vmap
+path) in a SUBPROCESS with the CPU-forcing env stripped, so the kernel
+actually lowers on the real chip.
+
+Skips — rather than fails — when no TPU is attached (laptop/CI without the
+tunnel), so the suite stays green off-chip while any machine with the chip
+gets the regression gate automatically.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.tpu
+def test_pallas_nms_matches_oracle_on_chip():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.default_backend())"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO)
+    if "tpu" not in probe.stdout:
+        pytest.skip(f"no TPU attached (backend: {probe.stdout.strip() or probe.stderr[-200:]})")
+
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_pallas.py")],
+        env=env, capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert res.returncode == 0, (
+        f"Pallas kernel-vs-oracle check failed:\n{res.stdout[-3000:]}\n"
+        f"{res.stderr[-2000:]}")
+    assert "equivalence: OK" in res.stdout

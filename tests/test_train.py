@@ -230,3 +230,7 @@ def test_multislice_mesh_validation():
         make_multislice_mesh(slices=3)  # 8 devices don't divide into 3
     with pytest.raises(ValueError):
         make_multislice_mesh(slices=0)
+    with pytest.raises(ValueError, match="uses only"):
+        # explicit data_per_slice smaller than the slice must not silently
+        # idle chips (round-1 advisor finding)
+        make_multislice_mesh(slices=2, data_per_slice=2)
