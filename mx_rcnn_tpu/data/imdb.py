@@ -67,19 +67,27 @@ class IMDB:
 
     def append_flipped_images(self, roidb: list) -> list:
         """Double the roidb with x-flipped records (reference semantics:
-        boxes mirrored on image width; loader flips pixels at read time)."""
-        flipped = []
-        for rec in roidb:
-            boxes = rec["boxes"].copy()
-            w = rec["width"]
+        boxes mirrored on image width; loader flips pixels at read time).
+        External proposals attached before flipping (the selective-search
+        path) are mirrored too."""
+
+        def mirror(boxes, w):
+            boxes = boxes.copy()
             x1 = boxes[:, 0].copy()
             x2 = boxes[:, 2].copy()
             boxes[:, 0] = w - x2 - 1
             boxes[:, 2] = w - x1 - 1
+            return boxes
+
+        flipped = []
+        for rec in roidb:
+            boxes = mirror(rec["boxes"], rec["width"])
             assert (boxes[:, 2] >= boxes[:, 0]).all()
             new = dict(rec)
             new["boxes"] = boxes
             new["flipped"] = True
+            if "proposals" in rec and len(rec["proposals"]):
+                new["proposals"] = mirror(rec["proposals"], rec["width"])
             flipped.append(new)
         logger.info("%s appended %d flipped images", self.name, len(flipped))
         return list(roidb) + flipped

@@ -111,6 +111,66 @@ class PascalVOC(IMDB):
             })
         return roidb
 
+    # -- selective search (legacy Fast-RCNN proposal source) -----------------
+    def selective_search_roidb(self, roidb: Optional[list] = None) -> list:
+        """Attach precomputed selective-search proposals (reference
+        ``selective_search_roidb``): loads the rbg-released
+        ``selective_search_data/voc_<year>_<set>.mat`` files (one per
+        ``+``-joined set, looked up under ``root_path``), whose per-image
+        cells are (K, 4) boxes in MATLAB (y1, x1, y2, x2) 1-based order —
+        reordered to 0-based (x1, y1, x2, y2) exactly like the reference's
+        ``boxes[:, (1, 0, 3, 2)] - 1``.
+
+        Divergence from the reference's offline pipeline, by design: the
+        reference bakes SS boxes into a merged roidb with precomputed
+        overlaps for host-side sampling; here they ride the ``proposals``
+        key that ``ROIIter``/``rcnn_train`` consume, with IoU + sampling
+        in-graph (the same path RPN-cached proposals use).  Attach BEFORE
+        ``append_flipped_images`` — flipping mirrors proposals too.
+        """
+        roidb = roidb if roidb is not None else self.gt_roidb()
+        box_list = self.load_cached("selective_search", self._load_ss_boxes)
+        if len(box_list) != len(roidb):
+            raise ValueError(
+                f"{len(box_list)} selective-search entries for "
+                f"{len(roidb)} images")
+        n = 0
+        cap = 2000  # ROIIter pads/truncates to RPN_POST_NMS_TOP_N rows
+        for boxes in box_list:
+            if len(boxes) > cap:
+                logger.warning(
+                    "an image carries %d selective-search boxes; ROIIter "
+                    "keeps the first TRAIN.RPN_POST_NMS_TOP_N (default "
+                    "2000) — SS boxes are UNRANKED, so raise the cap if "
+                    "the tail matters", len(boxes))
+                break
+        for rec, boxes in zip(roidb, box_list):
+            rec["proposals"] = boxes
+            n += len(boxes)
+        logger.info("%s: attached %d selective-search proposals", self.name, n)
+        return roidb
+
+    def _load_ss_boxes(self) -> list:
+        import scipy.io as sio
+
+        box_list: list = []
+        for s in self._sets:
+            year, split = s.split("_")
+            path = os.path.join(self.root_path, "selective_search_data",
+                                f"voc_{year}_{split}.mat")
+            raw = sio.loadmat(path)["boxes"].ravel()
+            for i in range(raw.shape[0]):
+                boxes = raw[i][:, (1, 0, 3, 2)] - 1  # y1x1y2x2 1-based → x1y1x2y2
+                box_list.append(boxes.astype(np.float32))
+        if len(box_list) != self.num_images:
+            # validate BEFORE load_cached pickles the result: a stale bad
+            # cache would otherwise survive fixed .mat files
+            raise ValueError(
+                f"{len(box_list)} selective-search entries for "
+                f"{self.num_images} images — wrong/partial "
+                "selective_search_data set?")
+        return box_list
+
     # -- evaluation ----------------------------------------------------------
     def write_results(self, detections, out_dir: str) -> None:
         """Official per-class result files (reference ``write_pascal_results``:

@@ -129,8 +129,12 @@ def get_imdb(args, cfg: Config, test: bool = False):
     raise KeyError(name)
 
 
-def get_train_roidb(imdb, cfg: Config):
-    roidb = imdb.gt_roidb()
+def get_train_roidb(imdb, cfg: Config, roidb=None):
+    """gt (or a pre-built ``roidb``, e.g. with proposals attached) → flip →
+    filter.  Proposal attachment must happen BEFORE this: flipping mirrors
+    the ``proposals`` key."""
+    if roidb is None:
+        roidb = imdb.gt_roidb()
     if cfg.TRAIN.FLIP:
         roidb = imdb.append_flipped_images(roidb)
     return imdb.filter_roidb(roidb)

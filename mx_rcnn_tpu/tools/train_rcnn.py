@@ -27,9 +27,24 @@ def train_rcnn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
                          f"mesh size {n_dev}")
     if roidb is None:
         imdb = get_imdb(args, cfg)
-        roidb = get_train_roidb(imdb, cfg)
+        source = getattr(args, "proposals", "")
+        base = None
+        if source == "selective_search":
+            # legacy Fast-RCNN input (reference selective_search_roidb)
+            if not hasattr(imdb, "selective_search_roidb"):
+                raise ValueError(
+                    f"--proposals selective_search is a PascalVOC input; "
+                    f"{type(imdb).__name__} has no selective-search data")
+            base = imdb.selective_search_roidb()
+        elif source:  # a test_rpn .pkl cache path (aligned with gt_roidb)
+            from mx_rcnn_tpu.utils.load_data import load_proposals
+
+            base = load_proposals(imdb.gt_roidb(), source)
+        # attach-then-flip: get_train_roidb mirrors the proposals key
+        roidb = get_train_roidb(imdb, cfg, roidb=base)
     if not any("proposals" in r for r in roidb):
-        raise ValueError("roidb has no cached proposals — run test_rpn first")
+        raise ValueError("roidb has no cached proposals — run test_rpn, or "
+                         "pass --proposals {selective_search|<cache.pkl>}")
     loader = ROIIter(roidb, cfg, batch_size, shuffle=cfg.TRAIN.SHUFFLE)
     if getattr(args, "num_steps", 0):
         loader = CappedLoader(loader, args.num_steps)
@@ -49,6 +64,12 @@ def train_rcnn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
 def parse_args():
     parser = argparse.ArgumentParser(description="Train Fast R-CNN on proposals")
     add_common_args(parser, train=True)
+    parser.add_argument("--proposals", default="",
+                        help="proposal source: 'selective_search' (loads "
+                             "root_path/selective_search_data/*.mat, the "
+                             "legacy Fast-RCNN input) or a test_rpn .pkl "
+                             "cache path; default expects proposals already "
+                             "in the roidb")
     return parser.parse_args()
 
 

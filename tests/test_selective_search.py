@@ -1,0 +1,94 @@
+"""Selective-search roidb path (the one gap PARITY.md declared in round 1,
+now closed): rbg-format .mat loading with the MATLAB (y1,x1,y2,x2) 1-based
+→ (x1,y1,x2,y2) 0-based reorder, proposal mirroring under flip, and the
+ROIIter → rcnn_train consumption of the attached proposals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+sio = pytest.importorskip("scipy.io")  # scipy ships in this image but is
+# not in the guaranteed-baked list; the SS path itself imports it lazily
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import ROIIter
+from mx_rcnn_tpu.data.pascal_voc import PascalVOC
+from tests.fixtures import make_mini_voc
+
+
+def _write_ss_mat(root, imdb, seed=0):
+    """Per-image random SS-style boxes in the rbg .mat format (cell array
+    of (K, 4) MATLAB-order 1-based boxes)."""
+    rng = np.random.RandomState(seed)
+    os.makedirs(os.path.join(root, "selective_search_data"), exist_ok=True)
+    cells = np.empty((1, imdb.num_images), object)
+    truth = []
+    for i in range(imdb.num_images):
+        k = rng.randint(3, 7)
+        x1 = rng.randint(0, 100, k)
+        y1 = rng.randint(0, 80, k)
+        x2 = x1 + rng.randint(5, 40, k)
+        y2 = y1 + rng.randint(5, 30, k)
+        # MATLAB order, 1-based
+        cells[0, i] = np.stack([y1 + 1, x1 + 1, y2 + 1, x2 + 1],
+                               axis=1).astype(np.float64)
+        truth.append(np.stack([x1, y1, x2, y2], axis=1).astype(np.float32))
+    sio.savemat(os.path.join(root, "selective_search_data",
+                             "voc_2007_trainval.mat"), {"boxes": cells})
+    return truth
+
+
+def test_ss_roidb_reorder_flip_and_roiiter(tmp_path):
+    make_mini_voc(str(tmp_path / "VOCdevkit"), n_train=6, n_test=2)
+    imdb = PascalVOC("2007_trainval", str(tmp_path / "data"),
+                     str(tmp_path / "VOCdevkit"))
+    truth = _write_ss_mat(str(tmp_path / "data"), imdb)
+
+    roidb = imdb.selective_search_roidb()
+    assert len(roidb) == 6
+    for rec, want in zip(roidb, truth):
+        np.testing.assert_array_equal(rec["proposals"], want)
+
+    # flip mirrors proposals on image width
+    flipped = imdb.append_flipped_images(roidb)
+    assert len(flipped) == 12
+    for orig, flip in zip(roidb, flipped[6:]):
+        w = orig["width"]
+        np.testing.assert_array_equal(
+            flip["proposals"][:, 0], w - orig["proposals"][:, 2] - 1)
+        np.testing.assert_array_equal(
+            flip["proposals"][:, 2], w - orig["proposals"][:, 0] - 1)
+        np.testing.assert_array_equal(
+            flip["proposals"][:, 1], orig["proposals"][:, 1])
+
+    # ROIIter consumes the attached proposals (the rcnn_train contract)
+    cfg = generate_config("resnet50", "PascalVOC",
+                          TRAIN__RPN_POST_NMS_TOP_N=32, TRAIN__FLIP=False)
+    cfg = cfg.replace(tpu=dataclasses.replace(cfg.tpu, SCALES=((64, 96),),
+                                              MAX_GT=8))
+    loader = ROIIter(flipped, cfg, batch_size=2, shuffle=False)
+    batch = next(iter(loader))
+    assert batch["rois"].shape == (2, 32, 4)
+    assert batch["roi_valid"].any()
+    assert {"images", "im_info", "gt_boxes", "gt_classes",
+            "gt_valid"} <= set(batch)
+
+
+def test_ss_roidb_count_mismatch_raises(tmp_path):
+    make_mini_voc(str(tmp_path / "VOCdevkit"), n_train=4, n_test=2)
+    imdb = PascalVOC("2007_trainval", str(tmp_path / "data"),
+                     str(tmp_path / "VOCdevkit"))
+    cells = np.empty((1, 2), object)  # wrong count
+    for i in range(2):
+        cells[0, i] = np.asarray([[1.0, 1.0, 5.0, 5.0]])
+    os.makedirs(str(tmp_path / "data" / "selective_search_data"),
+                exist_ok=True)
+    sio.savemat(str(tmp_path / "data" / "selective_search_data" /
+                    "voc_2007_trainval.mat"), {"boxes": cells})
+    with pytest.raises(ValueError, match="selective-search"):
+        imdb.selective_search_roidb()

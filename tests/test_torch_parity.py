@@ -1,0 +1,220 @@
+"""Numeric parity of the torch→npz converter against a REAL torch model.
+
+Round-1 only checked tree coverage (every expected path present); this
+executes an actual ``torch.nn`` ResNet-50 / VGG-16 — built with
+torchvision's exact module naming so the state_dict keys are the real
+checkpoint keys — and asserts our flax models produce the SAME features
+from the converted weights.  This is the strongest pretrained-weights
+evidence available offline: when a genuine torchvision .pth appears, the
+only untested delta is the download.
+
+Covers the subtle conversion paths: OIHW→HWIO, frozen-BN fold (scale into
+kernel + shift), the space-to-depth stem regroup (vs torch's direct 7×7/2),
+downsample→sc_conv/sc_bn, stage-4-as-RoI-head, and VGG's CHW→HWC fc6
+flatten permute.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+from mx_rcnn_tpu.models.backbones import ResNetConv, ResNetStage5, VGGConv, VGGFC
+from mx_rcnn_tpu.utils.convert_torch import convert
+
+
+# ---- torchvision-faithful torch models (exact state_dict keys) -----------
+
+class Bottleneck(nn.Module):
+    def __init__(self, cin, width, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride=stride, padding=1,
+                               bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, width * 4, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(width * 4)
+        self.relu = nn.ReLU(inplace=True)
+        self.downsample = downsample
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return self.relu(out + idt)
+
+
+def _layer(cin, width, units, stride):
+    down = nn.Sequential(nn.Conv2d(cin, width * 4, 1, stride=stride,
+                                   bias=False), nn.BatchNorm2d(width * 4))
+    mods = [Bottleneck(cin, width, stride, down)]
+    mods += [Bottleneck(width * 4, width) for _ in range(units - 1)]
+    return nn.Sequential(*mods)
+
+
+class TorchResNet50(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.relu = nn.ReLU(inplace=True)
+        self.maxpool = nn.MaxPool2d(3, stride=2, padding=1)
+        self.layer1 = _layer(64, 64, 3, 1)
+        self.layer2 = _layer(256, 128, 4, 2)
+        self.layer3 = _layer(512, 256, 6, 2)
+        self.layer4 = _layer(1024, 512, 3, 2)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        return self.layer1(x), self.layer2, self.layer3, self.layer4
+
+
+def _randomize_bn(model, rng):
+    """Non-trivial running stats so the frozen-BN fold is actually tested
+    (fresh BN has mean=0, var=1 which a broken fold could pass)."""
+    for m in model.modules():
+        if isinstance(m, nn.BatchNorm2d):
+            c = m.num_features
+            m.running_mean.copy_(torch.from_numpy(
+                rng.randn(c).astype(np.float32) * 0.3))
+            m.running_var.copy_(torch.from_numpy(
+                (rng.rand(c).astype(np.float32) * 0.8 + 0.6)))
+            m.weight.data.copy_(torch.from_numpy(
+                rng.rand(c).astype(np.float32) * 0.5 + 0.75))
+            m.bias.data.copy_(torch.from_numpy(
+                rng.randn(c).astype(np.float32) * 0.2))
+
+
+def _nest(flat, prefix):
+    """flat {'a/b/c': arr} under prefix → nested dict (converter output →
+    flax params)."""
+    out = {}
+    for path, arr in flat.items():
+        if not path.startswith(prefix + "/"):
+            continue
+        parts = path[len(prefix) + 1:].split("/")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = jnp.asarray(arr)
+    return out
+
+
+@pytest.fixture(scope="module")
+def torch_r50(rng_seed=7):
+    rng = np.random.RandomState(rng_seed)
+    torch.manual_seed(rng_seed)
+    m = TorchResNet50()
+    with torch.no_grad():
+        _randomize_bn(m, rng)
+    m.eval()
+    return m
+
+
+def test_resnet50_backbone_parity(torch_r50):
+    """torch conv1→layer3 (stride 16) vs our ResNetConv from converted
+    weights, f32, same input."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 3, 64, 96).astype(np.float32)
+
+    with torch.no_grad():
+        c4_t, *_ = torch_r50(torch.from_numpy(x))
+        # run layers 2-3 to the stride-16 feature
+        c4_t = torch_r50.layer3(torch_r50.layer2(c4_t))
+    want = c4_t.numpy().transpose(0, 2, 3, 1)  # NCHW → NHWC
+
+    sd = {k: v.numpy() for k, v in torch_r50.state_dict().items()}
+    flat = convert(sd, "resnet50")
+    params = _nest(flat, "backbone")
+
+    model = ResNetConv(depth="resnet50", dtype=jnp.float32)
+    init = model.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 64, 96, 3)))["params"]
+    # converted tree must cover the init tree exactly (no stragglers)
+    assert jax.tree_util.tree_structure(init) == \
+        jax.tree_util.tree_structure(params)
+    got = np.asarray(model.apply({"params": params},
+                                 jnp.asarray(x.transpose(0, 2, 3, 1))))
+
+    # eps differs (torch 1e-5 vs MXNet-contract 2e-5) → ~1e-5 relative on
+    # the BN scale; everything else is f32 conv reassociation
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+    assert np.abs(got - want).mean() < 2e-4
+
+
+def test_resnet50_stage4_head_parity(torch_r50):
+    """torch layer4 + global avgpool vs our ResNetStage5 (the RoI head
+    body) from the same converted weights."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 1024, 14, 14).astype(np.float32)
+    with torch.no_grad():
+        y = torch_r50.layer4(torch.from_numpy(x))
+        want = y.mean(dim=(2, 3)).numpy()  # global average pool
+
+    sd = {k: v.numpy() for k, v in torch_r50.state_dict().items()}
+    params = _nest(convert(sd, "resnet50"), "head_body")
+    head = ResNetStage5(depth="resnet50", dtype=jnp.float32)
+    got = np.asarray(head.apply({"params": params},
+                                jnp.asarray(x.transpose(0, 2, 3, 1))))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+class TorchVGG16(nn.Module):
+    """torchvision vgg16 layout: features Sequential with convs at the
+    canonical indices, classifier.0/.3 = fc6/fc7."""
+
+    def __init__(self):
+        super().__init__()
+        cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+               512, 512, 512, "M", 512, 512, 512, "M"]
+        layers, cin = [], 3
+        for v in cfg:
+            if v == "M":
+                layers.append(nn.MaxPool2d(2, 2))
+            else:
+                layers += [nn.Conv2d(cin, v, 3, padding=1), nn.ReLU(True)]
+                cin = v
+        self.features = nn.Sequential(*layers)
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 7 * 7, 4096), nn.ReLU(True), nn.Dropout(),
+            nn.Linear(4096, 4096), nn.ReLU(True), nn.Dropout(),
+            nn.Linear(4096, 1000))
+
+    def forward(self, x):
+        return self.features(x)
+
+
+def test_vgg16_parity():
+    torch.manual_seed(3)
+    m = TorchVGG16().eval()
+    rng = np.random.RandomState(2)
+
+    # conv body: VGGConv has no pool after block 5 → compare at features[:30]
+    x = rng.randn(1, 3, 64, 96).astype(np.float32)
+    with torch.no_grad():
+        want_conv = m.features[:30](torch.from_numpy(x)).numpy()
+    sd = {k: v.numpy() for k, v in m.state_dict().items()}
+    flat = convert(sd, "vgg16")
+    conv_params = _nest(flat, "backbone")
+    got_conv = np.asarray(VGGConv(dtype=jnp.float32).apply(
+        {"params": conv_params}, jnp.asarray(x.transpose(0, 2, 3, 1))))
+    np.testing.assert_allclose(got_conv, want_conv.transpose(0, 2, 3, 1),
+                               rtol=2e-3, atol=2e-3)
+
+    # fc6/fc7 on a pooled 7×7 feature: checks the CHW→HWC flatten permute
+    p = rng.randn(2, 512, 7, 7).astype(np.float32)
+    with torch.no_grad():
+        t = torch.from_numpy(p).flatten(1)
+        want_fc = m.classifier[4](m.classifier[3](
+            m.classifier[1](m.classifier[0](t)))).numpy()  # fc6→relu→fc7→relu
+    fc_params = _nest(flat, "head_body")
+    got_fc = np.asarray(VGGFC(dtype=jnp.float32).apply(
+        {"params": fc_params}, jnp.asarray(p.transpose(0, 2, 3, 1)),
+        deterministic=True))
+    np.testing.assert_allclose(got_fc, want_fc, rtol=2e-3, atol=2e-3)
