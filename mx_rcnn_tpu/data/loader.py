@@ -261,6 +261,17 @@ class ROIIter:
         self._inner = AnchorLoader(roidb, cfg, batch_size, shuffle, seed)
         self.cfg = cfg
         self.batch_size = batch_size
+        cap = cfg.TRAIN.RPN_POST_NMS_TOP_N
+        over = sum(len(r.get("proposals", ())) > cap for r in roidb)
+        if over:
+            from mx_rcnn_tpu.logger import logger
+
+            logger.warning(
+                "%d/%d images carry more than TRAIN.RPN_POST_NMS_TOP_N=%d "
+                "proposals; ROIIter keeps the FIRST %d rows — fine for "
+                "score-sorted RPN caches, lossy for unranked sources like "
+                "selective search (raise the cap if the tail matters)",
+                over, len(roidb), cap, cap)
 
     def __len__(self) -> int:
         return len(self._inner)

@@ -134,16 +134,8 @@ class PascalVOC(IMDB):
             raise ValueError(
                 f"{len(box_list)} selective-search entries for "
                 f"{len(roidb)} images")
-        n = 0
-        cap = 2000  # ROIIter pads/truncates to RPN_POST_NMS_TOP_N rows
-        for boxes in box_list:
-            if len(boxes) > cap:
-                logger.warning(
-                    "an image carries %d selective-search boxes; ROIIter "
-                    "keeps the first TRAIN.RPN_POST_NMS_TOP_N (default "
-                    "2000) — SS boxes are UNRANKED, so raise the cap if "
-                    "the tail matters", len(boxes))
-                break
+        n = 0  # (truncation vs TRAIN.RPN_POST_NMS_TOP_N is ROIIter's to
+        # diagnose — it knows the actual cap and warns on construction)
         for rec, boxes in zip(roidb, box_list):
             rec["proposals"] = boxes
             n += len(boxes)
@@ -160,6 +152,9 @@ class PascalVOC(IMDB):
                                 f"voc_{year}_{split}.mat")
             raw = sio.loadmat(path)["boxes"].ravel()
             for i in range(raw.shape[0]):
+                if raw[i].size == 0:  # empty MATLAB cell → no proposals
+                    box_list.append(np.zeros((0, 4), np.float32))
+                    continue
                 boxes = raw[i][:, (1, 0, 3, 2)] - 1  # y1x1y2x2 1-based → x1y1x2y2
                 box_list.append(boxes.astype(np.float32))
         if len(box_list) != self.num_images:

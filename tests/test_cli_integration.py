@@ -54,6 +54,8 @@ def run_cli(module: str, argv: list):
             return mod.test_rcnn(args)
         if module == "train_alternate":
             return mod.alternate_train(args)
+        if module == "demo":
+            return mod.demo_net(args)
         raise KeyError(module)
     finally:
         sys.argv = old
@@ -102,6 +104,24 @@ def test_voc_train_eval_cli(mini_voc):
     imdb.write_results(dets, str(out_dir))
     for cls in FIXTURE_CLASSES:
         assert (out_dir / f"comp4_det_2007_test_{cls}.txt").exists()
+
+
+def test_demo_cli(mini_voc):
+    """demo.py over the checkpoint trained above: single JPEG → detections
+    → visualization written (runs after test_voc_train_eval_cli in module
+    order; its checkpoint is the fixture)."""
+    import os
+
+    img = str(mini_voc / "VOCdevkit" / "VOC2007" / "JPEGImages" /
+              "001000.jpg")  # a test-split image the train never saw
+    out = str(mini_voc / "demo_out.jpg")
+    dets = run_cli("demo", [
+        "--network", "resnet50", "--dataset", "PascalVOC",
+        "--prefix", str(mini_voc / "model" / "e2e"), "--epoch", "6",
+        "--image", img, "--out", out, "--thresh", "0.3",
+    ] + TINY_TEST)
+    assert os.path.exists(out)
+    assert isinstance(dets, list)  # (label, (5,)) pairs; may be empty
 
 
 def test_voc_train_alternate_smoke(mini_voc):

@@ -71,8 +71,10 @@ class TorchResNet50(nn.Module):
         self.layer4 = _layer(1024, 512, 3, 2)
 
     def forward(self, x):
+        """→ the stride-16 c4 feature (conv1 through layer3), matching our
+        ResNetConv's output; layer4 is exercised separately as the head."""
         x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
-        return self.layer1(x), self.layer2, self.layer3, self.layer4
+        return self.layer3(self.layer2(self.layer1(x)))
 
 
 def _randomize_bn(model, rng):
@@ -124,9 +126,7 @@ def test_resnet50_backbone_parity(torch_r50):
     x = rng.randn(1, 3, 64, 96).astype(np.float32)
 
     with torch.no_grad():
-        c4_t, *_ = torch_r50(torch.from_numpy(x))
-        # run layers 2-3 to the stride-16 feature
-        c4_t = torch_r50.layer3(torch_r50.layer2(c4_t))
+        c4_t = torch_r50(torch.from_numpy(x))
     want = c4_t.numpy().transpose(0, 2, 3, 1)  # NCHW → NHWC
 
     sd = {k: v.numpy() for k, v in torch_r50.state_dict().items()}
