@@ -61,6 +61,56 @@ class MeshPlan:
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
+    @property
+    def n_model(self) -> int:
+        return self.mesh.shape.get("model", 1)
+
+    # -- tensor parallelism over the head FCs (model axis > 1) --------------
+    # The classic Megatron pairing on the RoI-head MLP, which is where the
+    # shardable parameters are (VGG fc6 alone is 25088×4096 ≈ 100M params;
+    # the FPN box head uses the same fc6/fc7 names): fc6 column-parallel
+    # (output features sharded — its bias shards with them; the relu/dropout
+    # between the FCs are elementwise on the sharded features), fc7
+    # row-parallel (contracts the sharded axis; XLA inserts the psum and
+    # the replicated fc7 bias adds after it).  Everything else replicates —
+    # conv backbones are data-parallel territory (SURVEY §2.3: DP is the
+    # reference's only strategy; the model axis is our extension point).
+    _TP_RULES = (
+        (("fc6", "kernel"), P(None, "model")),
+        (("fc6", "bias"), P("model")),
+        (("fc7", "kernel"), P("model", None)),
+        (("fc7", "bias"), P()),
+    )
+
+    def _tp_rule(self, path):
+        names = tuple(getattr(e, "key", getattr(e, "name", str(e)))
+                      for e in path)
+        for suffix, spec in self._TP_RULES:
+            if names[-len(suffix):] == tuple(suffix):
+                return NamedSharding(self.mesh, spec)
+        return self.replicated()
+
+    def param_shardings(self, params):
+        """Sharding tree for a param tree: replicated except the TP rules
+        above (no-op mesh without a >1 ``model`` axis → all replicated)."""
+        if self.n_model <= 1:
+            return jax.tree.map(lambda _: self.replicated(), params)
+        return jax.tree_util.tree_map_with_path(
+            lambda p, _: self._tp_rule(p), params)
+
+    def state_shardings(self, state):
+        """Sharding tree for a TrainState (same pytree structure, shardings
+        as leaves — jit's in_shardings/out_shardings form).  Optimizer-state
+        leaves match by PATH SUFFIX: optax's momentum trees keep the param
+        tree's key path as a suffix (…/trace/head_body/fc6/kernel), so the
+        same TP rules apply; scalar counts fall through to replicated."""
+        import dataclasses as _dc
+
+        return _dc.replace(
+            state, step=self.replicated(),
+            params=self.param_shardings(state.params),
+            opt_state=self.param_shardings(state.opt_state))
+
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
               data: Optional[int] = None, model: int = 1,

@@ -124,6 +124,26 @@ def make_train_step(model, tx: optax.GradientTransformation,
 
     repl = plan.replicated()
     batch_sh = plan.batch()
+    if plan.n_model > 1:
+        # tensor parallelism over the head FCs (MeshPlan.param_shardings):
+        # the state sharding tree is structural, so build it lazily from
+        # the first state argument and cache the jitted step
+        cache = {}
+
+        def stepper(state, batch, key):
+            fn = cache.get("fn")
+            if fn is None:
+                st_sh = plan.state_shardings(state)
+                fn = jax.jit(
+                    step,
+                    in_shardings=(st_sh, batch_sh, repl),
+                    out_shardings=(st_sh, repl),
+                    donate_argnums=(0,) if donate else (),
+                )
+                cache["fn"] = fn
+            return fn(state, batch, key)
+
+        return stepper
     return jax.jit(
         step,
         in_shardings=(repl, batch_sh, repl),

@@ -112,6 +112,10 @@ def test_demo_cli(mini_voc):
     order; its checkpoint is the fixture)."""
     import os
 
+    if not (mini_voc / "model" / "e2e").exists():
+        pytest.skip("needs the checkpoint from test_voc_train_eval_cli "
+                    "(module runs in file order; selected-alone there is "
+                    "nothing to demo)")
     img = str(mini_voc / "VOCdevkit" / "VOC2007" / "JPEGImages" /
               "001000.jpg")  # a test-split image the train never saw
     out = str(mini_voc / "demo_out.jpg")
