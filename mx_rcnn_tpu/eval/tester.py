@@ -141,7 +141,8 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
               max_per_image: Optional[int] = None,
               thresh: Optional[float] = None,
               vis: bool = False,
-              with_masks: bool = False) -> dict:
+              with_masks: bool = False,
+              det_cache: Optional[str] = None) -> dict:
     """Dataset eval loop (reference ``pred_eval``): all_boxes[cls][image] =
     (N, 5) [x1,y1,x2,y2,score]; per-class score threshold + NMS; global
     per-image cap; then ``imdb.evaluate_detections``.
@@ -149,6 +150,10 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
     ``with_masks`` (Mask R-CNN configs): runs the mask branch on the final
     detections, pastes 28×28 probabilities into full-image RLEs, and scores
     segm alongside bbox (``imdb.evaluate_sds``).
+
+    ``det_cache``: pickle the final ``all_boxes`` there (the reference
+    writes ``detections.pkl`` into the imdb cache; ``tools/reeval.py``
+    re-scores it without a model or device).
     """
     cfg = predictor.cfg
     if max_per_image is None:
@@ -210,6 +215,10 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
         if done % 100 < len(dets):
             logger.info("im_detect: %d/%d  %.3fs/im", done, num_images,
                         (time.time() - t0) / max(done, 1))
+    if det_cache:
+        with open(det_cache, "wb") as f:
+            pickle.dump(all_boxes, f, pickle.HIGHEST_PROTOCOL)
+        logger.info("cached detections to %s", det_cache)
     if with_masks:
         return imdb.evaluate_sds(all_boxes, all_masks)
     return imdb.evaluate_detections(all_boxes)

@@ -104,9 +104,7 @@ class MeshPlan:
         leaves match by PATH SUFFIX: optax's momentum trees keep the param
         tree's key path as a suffix (…/trace/head_body/fc6/kernel), so the
         same TP rules apply; scalar counts fall through to replicated."""
-        import dataclasses as _dc
-
-        return _dc.replace(
+        return dataclasses.replace(
             state, step=self.replicated(),
             params=self.param_shardings(state.params),
             opt_state=self.param_shardings(state.opt_state))

@@ -19,6 +19,9 @@ def parse_args():
     parser = argparse.ArgumentParser(description="Test a Faster R-CNN network")
     add_common_args(parser, train=False)
     parser.add_argument("--batch_images", type=int, default=1)
+    parser.add_argument("--dets_cache", default="",
+                        help="pickle all_boxes here for tools/reeval.py "
+                             "(the reference's detections.pkl)")
     return parser.parse_args()
 
 
@@ -31,7 +34,8 @@ def test_rcnn(args):
     predictor = Predictor(model, params, cfg)
     loader = TestLoader(roidb, cfg, batch_size=args.batch_images)
     stats = pred_eval(predictor, loader, imdb, thresh=args.thresh,
-                      vis=args.vis, with_masks=cfg.network.HAS_MASK)
+                      vis=args.vis, with_masks=cfg.network.HAS_MASK,
+                      det_cache=args.dets_cache or None)
 
     def flat(d, prefix=""):
         out = {}

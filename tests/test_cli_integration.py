@@ -187,3 +187,44 @@ def test_coco_pipeline_files(tmp_path):
     # COCOeval protocol keys present (AP may legitimately be ~0 at random
     # weights); the writeout file must exist
     assert "AP" in stats or any("AP" in k for k in stats)
+
+
+def test_coco_segm_eval_files(tmp_path):
+    """Mask config over mini-COCO FILES: polygon segmentations parse into
+    the roidb, the mask branch runs at eval, masks paste into full-image
+    RLEs, and ``evaluate_sds`` scores bbox AND segm through the COCOeval
+    protocol (random weights — mechanics, not accuracy)."""
+    import dataclasses
+
+    import jax
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.data import TestLoader
+    from mx_rcnn_tpu.data.coco_dataset import COCODataset
+    from mx_rcnn_tpu.eval import Predictor, pred_eval
+    from mx_rcnn_tpu.models import build_model, init_params
+    from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
+
+    make_mini_coco(str(tmp_path / "coco"), image_set="minitrain", n=2,
+                   with_masks=True)
+    cfg = generate_config(
+        "resnet101_fpn_mask", "coco",
+        TEST__RPN_PRE_NMS_TOP_N=200, TEST__RPN_POST_NMS_TOP_N=16,
+        TEST__MAX_PER_IMAGE=5,
+    )
+    cfg = cfg.replace(
+        network=dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4)),
+        tpu=dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=8))
+
+    imdb = COCODataset("minitrain", str(tmp_path / "data"),
+                       str(tmp_path / "coco"))
+    roidb = imdb.gt_roidb()
+    assert any(r.get("segmentation") for r in roidb), "polygons must load"
+    model = build_model(cfg)
+    params = denormalize_for_save(
+        init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96)), cfg)
+    stats = pred_eval(Predictor(model, params, cfg),
+                      TestLoader(roidb, cfg, batch_size=1), imdb,
+                      thresh=1e-3, with_masks=True)
+    assert "bbox" in stats and "segm" in stats, stats
+    assert "AP" in stats["segm"]

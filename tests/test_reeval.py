@@ -1,0 +1,67 @@
+"""tools/reeval.py: re-score a pickled all_boxes without model/device
+(reference ``rcnn/tools/reeval.py``), fed by pred_eval's ``det_cache``
+(the reference's detections.pkl contract)."""
+
+from __future__ import annotations
+
+import pickle
+import sys
+
+import numpy as np
+
+from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+
+
+def test_reeval_cli_roundtrip(tmp_path):
+    # constructor args must mirror tools/common.get_imdb's synthetic branch
+    # (num_classes=cfg.NUM_CLASSES, size=SCALES[0], default seed) so the
+    # CLI rebuilds the SAME gt this test made detections from
+    ds = SyntheticDataset(num_images=3, num_classes=21, height=600,
+                          width=1000)
+    roidb = ds.gt_roidb()
+    # perfect detections straight from gt → mAP must be 1 for present
+    # classes
+    all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(3)]
+                 for _ in range(ds.num_classes)]
+    present = set()
+    for i, rec in enumerate(roidb):
+        for b, c in zip(rec["boxes"], rec["gt_classes"]):
+            det = np.concatenate([b, [0.9]]).astype(np.float32)[None]
+            all_boxes[int(c)][i] = np.concatenate(
+                [all_boxes[int(c)][i], det])
+            present.add(int(c))
+    cache = tmp_path / "dets.pkl"
+    with open(cache, "wb") as f:
+        pickle.dump(all_boxes, f)
+
+    from mx_rcnn_tpu.tools import reeval as reeval_mod
+
+    old = sys.argv
+    sys.argv = ["reeval.py", "--synthetic", "--synthetic_images", "3",
+                "--detections", str(cache)]
+    try:
+        stats = reeval_mod.reeval(reeval_mod.parse_args())
+    finally:
+        sys.argv = old
+    for c in present:
+        assert stats[ds.classes[c]] > 0.99, (c, stats)
+
+
+def test_pred_eval_writes_det_cache(tmp_path):
+    """pred_eval(det_cache=...) writes a pickle reeval can consume."""
+    from tests.test_eval_edges import (RecordingIMDB, StubLoader,
+                                       StubPredictor, _setup)
+
+    cfg, batch, boxes, roidb = _setup()
+    scores = np.zeros((1, 12, 3), np.float32)
+    scores[0, :4, 1] = [0.9, 0.8, 0.7, 0.6]
+    from mx_rcnn_tpu.eval.tester import pred_eval
+
+    imdb = RecordingIMDB(num_classes=3, num_images=1)
+    cache = tmp_path / "dets.pkl"
+    pred_eval(StubPredictor(cfg, scores, boxes), StubLoader(batch, roidb),
+              imdb, max_per_image=10, thresh=0.05, det_cache=str(cache))
+    with open(cache, "rb") as f:
+        cached = pickle.load(f)
+    assert len(cached) == 3
+    assert len(cached[1][0]) == 4
