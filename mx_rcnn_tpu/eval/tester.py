@@ -168,6 +168,16 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
                        type(imdb).__name__)
         with_masks = False
 
+    if det_cache:
+        # fail on an unwritable path BEFORE the inference loop, not after
+        # hours of forward passes
+        import os
+
+        d = os.path.dirname(det_cache)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        open(det_cache, "ab").close()
+
     all_boxes: List[List] = [[None for _ in range(num_images)]
                              for _ in range(num_classes)]
     all_masks: Optional[List[List]] = (

@@ -98,8 +98,12 @@ def config_from_args(args, train: bool = True) -> Config:
             overrides["TRAIN__SHUFFLE"] = False
     cfg = generate_config(args.network, args.dataset, **overrides)
     if args.image_set:
+        # train drivers read IMAGE_SET; test-mode drivers (test.py, reeval,
+        # demo) read TEST_IMAGE_SET via get_imdb(test=True) — the override
+        # must land on the field the driver actually consumes
+        field = "IMAGE_SET" if train else "TEST_IMAGE_SET"
         cfg = cfg.replace(dataset=dataclasses.replace(
-            cfg.dataset, IMAGE_SET=args.image_set))
+            cfg.dataset, **{field: args.image_set}))
     if args.dataset_path:
         cfg = cfg.replace(dataset=dataclasses.replace(
             cfg.dataset, DATASET_PATH=args.dataset_path))

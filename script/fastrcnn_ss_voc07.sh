@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Legacy Fast-RCNN: train the box head on precomputed selective-search
+# proposals (the reference's selective_search_roidb path).  Expects the rbg
+# release at data/selective_search_data/voc_2007_trainval.mat and a
+# converted VGG-16 at model/vgg16.npz.
+set -e
+python -m mx_rcnn_tpu.tools.train_rcnn --network vgg16 --dataset PascalVOC \
+  --image_set 2007_trainval --proposals selective_search \
+  --pretrained model/vgg16.npz \
+  --prefix model/fastrcnn_ss --end_epoch 10 --lr 0.001 --lr_step 7 "$@"

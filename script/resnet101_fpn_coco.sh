@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# ResNet-101-FPN Faster R-CNN on COCO (BASELINE.json config 4).
+# Expects COCO under data/coco (train2017/val2017 + annotations) and a
+# converted backbone at model/resnet101.npz (utils/convert_torch.py).
+set -e
+python train_end2end.py --network resnet101_fpn --dataset coco \
+  --pretrained model/resnet101.npz \
+  --prefix model/fpn_coco --end_epoch 7 --lr 0.00125 --lr_step 5,6 "$@"
+python test.py --network resnet101_fpn --dataset coco \
+  --prefix model/fpn_coco --epoch 7

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Mask R-CNN (ResNet-101-FPN) on COCO (BASELINE.json config 5).
+# Mask configs train end2end only (the alternate pipeline has no
+# mask-target path — see models/fpn.py:rcnn_train).
+set -e
+python train_end2end.py --network resnet101_fpn_mask --dataset coco \
+  --pretrained model/resnet101.npz \
+  --prefix model/mask_coco --end_epoch 7 --lr 0.00125 --lr_step 5,6 "$@"
+python test.py --network resnet101_fpn_mask --dataset coco \
+  --prefix model/mask_coco --epoch 7

@@ -12,8 +12,20 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 
 import numpy as np
+
+
+def run_tool(mod, main, argv):
+    """Invoke a CLI module's parse_args + main under a temporary sys.argv
+    (the shared argv-juggling for driving real drivers in-process)."""
+    old = sys.argv
+    sys.argv = [mod.__name__ + ".py"] + list(argv)
+    try:
+        return main(mod.parse_args())
+    finally:
+        sys.argv = old
 
 # three visually distinct classes; names must be real VOC classes so the
 # PascalVOC name→index mapping applies unchanged

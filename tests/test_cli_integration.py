@@ -18,12 +18,12 @@ jax init + jit cache per subprocess.
 from __future__ import annotations
 
 import importlib
-import sys
 
 import numpy as np
 import pytest
 
-from tests.fixtures import FIXTURE_CLASSES, make_mini_coco, make_mini_voc
+from tests.fixtures import (FIXTURE_CLASSES, make_mini_coco, make_mini_voc,
+                            run_tool)
 
 TINY = [
     "--cfg", "tpu__SCALES=((64,96),)",
@@ -42,23 +42,13 @@ TINY_TEST = TINY + [
 ]
 
 
+_MAINS = {"train_end2end": "train_net", "test": "test_rcnn",
+          "train_alternate": "alternate_train", "demo": "demo_net"}
+
+
 def run_cli(module: str, argv: list):
     mod = importlib.import_module(module)
-    old = sys.argv
-    sys.argv = [module + ".py"] + argv
-    try:
-        args = mod.parse_args()
-        if module == "train_end2end":
-            return mod.train_net(args)
-        if module == "test":
-            return mod.test_rcnn(args)
-        if module == "train_alternate":
-            return mod.alternate_train(args)
-        if module == "demo":
-            return mod.demo_net(args)
-        raise KeyError(module)
-    finally:
-        sys.argv = old
+    return run_tool(mod, getattr(mod, _MAINS[module]), argv)
 
 
 @pytest.fixture(scope="module")
@@ -81,11 +71,22 @@ def test_voc_train_eval_cli(mini_voc):
         "--batch_images", "2", "--lr", "0.005", "--frequent", "8",
     ] + TINY_TRAIN)
 
+    dets_pkl = str(mini_voc / "dets.pkl")
     stats = run_cli("test", common + [
         "--image_set", "2007_test", "--epoch", "6",
+        "--dets_cache", dets_pkl,
     ] + TINY_TEST)
     fixture_map = float(np.mean([stats[c] for c in FIXTURE_CLASSES]))
     assert fixture_map > 0.2, stats
+
+    # reeval re-scores the cached detections to the same mAP, model-free
+    from mx_rcnn_tpu.tools import reeval as reeval_mod
+
+    re_stats = run_tool(
+        reeval_mod, reeval_mod.reeval,
+        common + ["--image_set", "2007_test", "--detections", dets_pkl]
+        + TINY_TEST)
+    assert abs(re_stats["mAP"] - stats["mAP"]) < 1e-6
     # absent classes must score 0 (no spurious credit)
     absent = [v for k, v in stats.items()
               if k not in FIXTURE_CLASSES and k != "mAP"]
@@ -146,10 +147,10 @@ def test_voc_train_alternate_smoke(mini_voc):
     assert os.path.isdir(str(mini_voc / "model"))
 
 
-def test_coco_pipeline_files(tmp_path):
-    """mini-COCO on disk: json parse → roidb → TestLoader → pred_eval →
-    result-json writeout + COCOeval stats (random weights — the assertion
-    is the file pipeline's mechanics, accuracy is VOC's job above)."""
+def _coco_eval_setup(tmp_path, network: str, n_images: int,
+                     max_per_image: int):
+    """Shared mini-COCO-on-disk eval harness: fixture files → imdb/roidb →
+    random-weight Predictor + TestLoader (mechanics, not accuracy)."""
     import dataclasses
 
     import jax
@@ -157,32 +158,41 @@ def test_coco_pipeline_files(tmp_path):
     from mx_rcnn_tpu.config import generate_config
     from mx_rcnn_tpu.data import TestLoader
     from mx_rcnn_tpu.data.coco_dataset import COCODataset
-    from mx_rcnn_tpu.eval import Predictor, pred_eval
+    from mx_rcnn_tpu.eval import Predictor
     from mx_rcnn_tpu.models import build_model, init_params
     from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
 
-    make_mini_coco(str(tmp_path / "coco"), image_set="minitrain", n=4)
+    make_mini_coco(str(tmp_path / "coco"), image_set="minitrain",
+                   n=n_images, with_masks=True)
     cfg = generate_config(
-        "resnet50", "coco",
+        network, "coco",
         TEST__RPN_PRE_NMS_TOP_N=200, TEST__RPN_POST_NMS_TOP_N=16,
-        TEST__MAX_PER_IMAGE=10,
+        TEST__MAX_PER_IMAGE=max_per_image,
     )
     cfg = cfg.replace(
         network=dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4)),
         tpu=dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=8))
-
     imdb = COCODataset("minitrain", str(tmp_path / "data"),
                        str(tmp_path / "coco"))
-    assert imdb.num_images == 4
-    assert imdb.num_classes == 1 + len(FIXTURE_CLASSES)
     roidb = imdb.gt_roidb()
-    assert all(r["boxes"].shape[1] == 4 for r in roidb)
-
     model = build_model(cfg)
     params = denormalize_for_save(
         init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96)), cfg)
-    loader = TestLoader(roidb, cfg, batch_size=2)
-    stats = pred_eval(Predictor(model, params, cfg), loader, imdb,
+    return cfg, imdb, roidb, Predictor(model, params, cfg), TestLoader
+
+
+def test_coco_pipeline_files(tmp_path):
+    """mini-COCO on disk: json parse → roidb → TestLoader → pred_eval →
+    result-json writeout + COCOeval stats (random weights — the assertion
+    is the file pipeline's mechanics, accuracy is VOC's job above)."""
+    from mx_rcnn_tpu.eval import pred_eval
+
+    cfg, imdb, roidb, pred, TestLoader = _coco_eval_setup(
+        tmp_path, "resnet50", n_images=4, max_per_image=10)
+    assert imdb.num_images == 4
+    assert imdb.num_classes == 1 + len(FIXTURE_CLASSES)
+    assert all(r["boxes"].shape[1] == 4 for r in roidb)
+    stats = pred_eval(pred, TestLoader(roidb, cfg, batch_size=2), imdb,
                       thresh=1e-3)
     # COCOeval protocol keys present (AP may legitimately be ~0 at random
     # weights); the writeout file must exist
@@ -194,37 +204,12 @@ def test_coco_segm_eval_files(tmp_path):
     the roidb, the mask branch runs at eval, masks paste into full-image
     RLEs, and ``evaluate_sds`` scores bbox AND segm through the COCOeval
     protocol (random weights — mechanics, not accuracy)."""
-    import dataclasses
+    from mx_rcnn_tpu.eval import pred_eval
 
-    import jax
-
-    from mx_rcnn_tpu.config import generate_config
-    from mx_rcnn_tpu.data import TestLoader
-    from mx_rcnn_tpu.data.coco_dataset import COCODataset
-    from mx_rcnn_tpu.eval import Predictor, pred_eval
-    from mx_rcnn_tpu.models import build_model, init_params
-    from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
-
-    make_mini_coco(str(tmp_path / "coco"), image_set="minitrain", n=2,
-                   with_masks=True)
-    cfg = generate_config(
-        "resnet101_fpn_mask", "coco",
-        TEST__RPN_PRE_NMS_TOP_N=200, TEST__RPN_POST_NMS_TOP_N=16,
-        TEST__MAX_PER_IMAGE=5,
-    )
-    cfg = cfg.replace(
-        network=dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4)),
-        tpu=dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=8))
-
-    imdb = COCODataset("minitrain", str(tmp_path / "data"),
-                       str(tmp_path / "coco"))
-    roidb = imdb.gt_roidb()
+    cfg, imdb, roidb, pred, TestLoader = _coco_eval_setup(
+        tmp_path, "resnet101_fpn_mask", n_images=2, max_per_image=5)
     assert any(r.get("segmentation") for r in roidb), "polygons must load"
-    model = build_model(cfg)
-    params = denormalize_for_save(
-        init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96)), cfg)
-    stats = pred_eval(Predictor(model, params, cfg),
-                      TestLoader(roidb, cfg, batch_size=1), imdb,
+    stats = pred_eval(pred, TestLoader(roidb, cfg, batch_size=1), imdb,
                       thresh=1e-3, with_masks=True)
     assert "bbox" in stats and "segm" in stats, stats
     assert "AP" in stats["segm"]

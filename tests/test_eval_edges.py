@@ -130,6 +130,21 @@ def test_max_per_image_cap_across_classes():
         np.sort(np.concatenate([c1, c2])), [0.75, 0.8, 0.85, 0.9], atol=1e-6)
 
 
+def test_vis_all_detection_writes_file(tmp_path):
+    """pred_eval(vis=True)'s drawing path: vis_all_detection renders the
+    per-class detections onto the image array and writes a jpg."""
+    from mx_rcnn_tpu.eval.tester import vis_all_detection
+
+    rec = {"image_array": np.full((64, 96, 3), 127, np.uint8),
+           "height": 64, "width": 96}
+    dets = [None,
+            np.asarray([[5, 5, 40, 40, 0.9]], np.float32),
+            np.asarray([[50, 10, 90, 60, 0.4]], np.float32)]
+    out = tmp_path / "vis.jpg"
+    vis_all_detection(rec, dets, ["bg", "a", "b"], str(out), thresh=0.3)
+    assert out.exists() and out.stat().st_size > 0
+
+
 def test_mask_chunk_drain_exceeds_chunk():
     """Mask pass with cap 4 but 10 surviving detections per image: the
     static chunk is R=4, so the drain loop must run 3 passes and every

@@ -5,7 +5,6 @@
 from __future__ import annotations
 
 import pickle
-import sys
 
 import numpy as np
 
@@ -35,14 +34,11 @@ def test_reeval_cli_roundtrip(tmp_path):
         pickle.dump(all_boxes, f)
 
     from mx_rcnn_tpu.tools import reeval as reeval_mod
+    from tests.fixtures import run_tool
 
-    old = sys.argv
-    sys.argv = ["reeval.py", "--synthetic", "--synthetic_images", "3",
-                "--detections", str(cache)]
-    try:
-        stats = reeval_mod.reeval(reeval_mod.parse_args())
-    finally:
-        sys.argv = old
+    stats = run_tool(reeval_mod, reeval_mod.reeval,
+                     ["--synthetic", "--synthetic_images", "3",
+                      "--detections", str(cache)])
     for c in present:
         assert stats[ds.classes[c]] > 0.99, (c, stats)
 
