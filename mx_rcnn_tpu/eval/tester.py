@@ -10,6 +10,7 @@ training.
 
 from __future__ import annotations
 
+import os
 import pickle
 import time
 from typing import List, Optional
@@ -171,8 +172,6 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
     if det_cache:
         # fail on an unwritable path BEFORE the inference loop, not after
         # hours of forward passes
-        import os
-
         d = os.path.dirname(det_cache)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -209,8 +208,6 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
                         keep = all_boxes[k][i][:, 4] >= th
                         all_boxes[k][i] = all_boxes[k][i][keep]
             if vis:
-                import os
-
                 vis_dir = "vis"
                 os.makedirs(vis_dir, exist_ok=True)
                 vis_all_detection(

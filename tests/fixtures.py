@@ -67,8 +67,12 @@ def make_mini_voc(dataset_path: str, n_train: int = 16, n_test: int = 8,
     for sub in ("JPEGImages", "Annotations", os.path.join("ImageSets", "Main")):
         os.makedirs(os.path.join(devkit, sub), exist_ok=True)
 
+    # "minitest" is deliberately NOT a standard VOC split name: test-mode
+    # drivers must route --image_set through TEST_IMAGE_SET (the field
+    # get_imdb(test=True) reads) — a standard name would mask a regression
+    # by coinciding with the preset default
     splits = {"trainval": [f"{i:06d}" for i in range(n_train)],
-              "test": [f"{1000 + i:06d}" for i in range(n_test)]}
+              "minitest": [f"{1000 + i:06d}" for i in range(n_test)]}
     for split, ids in splits.items():
         with open(os.path.join(devkit, "ImageSets", "Main", split + ".txt"),
                   "w") as f:
@@ -90,7 +94,7 @@ def make_mini_voc(dataset_path: str, n_train: int = 16, n_test: int = 8,
             with open(os.path.join(devkit, "Annotations", idx + ".xml"),
                       "w") as f:
                 f.write("\n".join(xml))
-    return splits["trainval"], splits["test"]
+    return splits["trainval"], splits["minitest"]
 
 
 def make_mini_coco(dataset_path: str, image_set: str = "minitrain",

@@ -73,7 +73,7 @@ def test_voc_train_eval_cli(mini_voc):
 
     dets_pkl = str(mini_voc / "dets.pkl")
     stats = run_cli("test", common + [
-        "--image_set", "2007_test", "--epoch", "6",
+        "--image_set", "2007_minitest", "--epoch", "6",
         "--dets_cache", dets_pkl,
     ] + TINY_TEST)
     fixture_map = float(np.mean([stats[c] for c in FIXTURE_CLASSES]))
@@ -84,7 +84,7 @@ def test_voc_train_eval_cli(mini_voc):
 
     re_stats = run_tool(
         reeval_mod, reeval_mod.reeval,
-        common + ["--image_set", "2007_test", "--detections", dets_pkl]
+        common + ["--image_set", "2007_minitest", "--detections", dets_pkl]
         + TINY_TEST)
     assert abs(re_stats["mAP"] - stats["mAP"]) < 1e-6
     # absent classes must score 0 (no spurious credit)
@@ -96,7 +96,7 @@ def test_voc_train_eval_cli(mini_voc):
     out_dir = mini_voc / "results"
     from mx_rcnn_tpu.data.pascal_voc import PascalVOC
 
-    imdb = PascalVOC("2007_test", str(mini_voc / "data"),
+    imdb = PascalVOC("2007_minitest", str(mini_voc / "data"),
                      str(mini_voc / "VOCdevkit"))
     # re-evaluate from files via the imdb round trip: parse the comp4 files
     # back and check they contain detections for the fixture classes
@@ -104,7 +104,7 @@ def test_voc_train_eval_cli(mini_voc):
             for _ in range(imdb.num_classes)]
     imdb.write_results(dets, str(out_dir))
     for cls in FIXTURE_CLASSES:
-        assert (out_dir / f"comp4_det_2007_test_{cls}.txt").exists()
+        assert (out_dir / f"comp4_det_2007_minitest_{cls}.txt").exists()
 
 
 def test_demo_cli(mini_voc):
@@ -178,7 +178,7 @@ def _coco_eval_setup(tmp_path, network: str, n_images: int,
     model = build_model(cfg)
     params = denormalize_for_save(
         init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96)), cfg)
-    return cfg, imdb, roidb, Predictor(model, params, cfg), TestLoader
+    return cfg, imdb, roidb, Predictor(model, params, cfg)
 
 
 def test_coco_pipeline_files(tmp_path):
@@ -187,7 +187,9 @@ def test_coco_pipeline_files(tmp_path):
     is the file pipeline's mechanics, accuracy is VOC's job above)."""
     from mx_rcnn_tpu.eval import pred_eval
 
-    cfg, imdb, roidb, pred, TestLoader = _coco_eval_setup(
+    from mx_rcnn_tpu.data import TestLoader
+
+    cfg, imdb, roidb, pred = _coco_eval_setup(
         tmp_path, "resnet50", n_images=4, max_per_image=10)
     assert imdb.num_images == 4
     assert imdb.num_classes == 1 + len(FIXTURE_CLASSES)
@@ -206,7 +208,9 @@ def test_coco_segm_eval_files(tmp_path):
     protocol (random weights — mechanics, not accuracy)."""
     from mx_rcnn_tpu.eval import pred_eval
 
-    cfg, imdb, roidb, pred, TestLoader = _coco_eval_setup(
+    from mx_rcnn_tpu.data import TestLoader
+
+    cfg, imdb, roidb, pred = _coco_eval_setup(
         tmp_path, "resnet101_fpn_mask", n_images=2, max_per_image=5)
     assert any(r.get("segmentation") for r in roidb), "polygons must load"
     stats = pred_eval(pred, TestLoader(roidb, cfg, batch_size=1), imdb,

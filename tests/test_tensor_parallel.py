@@ -83,6 +83,32 @@ def test_tp_step_matches_unsharded(seed):
     assert mom and mom[0].sharding.spec == P(None, "model")
 
 
+def test_tp_checkpoint_roundtrip(tmp_path):
+    """Checkpointing a TP-sharded TrainState: orbax must save the sharded
+    params and restore them loadable (the fit() epoch-end path with a
+    model-axis mesh)."""
+    from mx_rcnn_tpu.train.checkpoint import CheckpointManager
+
+    cfg = vgg_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    plan = make_mesh(data=4, model=2)
+    state, tx, mask = create_train_state(cfg, params, steps_per_epoch=10)
+    step = make_train_step(model, tx, plan=plan, trainable_mask=mask)
+    state, _ = step(state, shard_batch(plan, make_batch(4)),
+                    jax.random.PRNGKey(0))
+    assert state.params["head_body"]["fc6"]["kernel"].sharding.spec == \
+        P(None, "model")
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save_epoch(1, state.params, cfg, opt_state=state.opt_state, step=1)
+    restored, _, _ = mgr.load_epoch(1, cfg, for_training=False)
+    np.testing.assert_allclose(
+        np.asarray(restored["head_body"]["fc6"]["kernel"]),
+        np.asarray(jax.device_get(state.params["head_body"]["fc6"]["kernel"])),
+        rtol=1e-5)
+
+
 def test_tp_plan_replicates_without_model_axis():
     cfg = vgg_cfg()
     model = build_model(cfg)
