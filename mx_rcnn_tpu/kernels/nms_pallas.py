@@ -200,11 +200,24 @@ def _nms_vmappable(max_out: int, iou_thresh: float):
 
     @fn.def_vmap
     def _rule(axis_size, in_batched, boxes, scores, valid):
-        args = [
+        del scores  # selection order is index order (the _nms_core contract)
+        boxes, valid = (
             a if b else jnp.broadcast_to(a[None], (axis_size,) + a.shape)
-            for a, b in zip((boxes, scores, valid), in_batched)
-        ]
-        out = jax.lax.map(lambda t: fn(*t), tuple(args))
+            for a, b in zip((boxes, valid),
+                            (in_batched[0], in_batched[2]))
+        )
+        # only the Mosaic kernels need the per-image serial loop (their
+        # SMEM specs can't auto-batch); prep and post are ordinary jnp and
+        # vectorize over the batch.  Measured perf-neutral at B=8 (the
+        # scan's residual cost is kernel sequencing, not glue), but the
+        # scan body stays minimal and the prep/post batch like any jnp op
+        n = boxes.shape[1]
+        prep = jax.vmap(partial(_nms_prep, iou_thresh=iou_thresh))
+        kernels = partial(_nms_kernels, max_out=max_out,
+                         iou_thresh=iou_thresh)
+        post = jax.vmap(partial(_nms_post, n=n, max_out=max_out))
+        keep_words = jax.lax.map(lambda t: kernels(*t), prep(boxes, valid))
+        out = post(keep_words)
         return out, (True, True)
 
     _VMAP_CACHE[(max_out, iou_thresh)] = fn
@@ -218,6 +231,15 @@ def _nms_core(boxes: jnp.ndarray, scores: jnp.ndarray, valid: jnp.ndarray,
               max_out: int, iou_thresh: float):
     del scores  # selection order is index order (callers pass sorted boxes)
     n = boxes.shape[0]
+    keep_words = _nms_kernels(*_nms_prep(boxes, valid, iou_thresh),
+                              max_out=max_out, iou_thresh=iou_thresh)
+    return _nms_post(keep_words, n=n, max_out=max_out)
+
+
+def _nms_prep(boxes: jnp.ndarray, valid: jnp.ndarray, iou_thresh: float):
+    """Host-of-kernel data prep (pure jnp, vmappable): pad, regroup column
+    boxes for the bit-lane loop, pack the 8×8 block-diagonal + validity."""
+    n = boxes.shape[0]
     n_pad = _pad_to(n, _PAD)   # (n_pad/_PL) lane-aligned, divisible by _BR
     w32 = n_pad // _PL
 
@@ -228,29 +250,6 @@ def _nms_core(boxes: jnp.ndarray, scores: jnp.ndarray, valid: jnp.ndarray,
     # column boxes regrouped so bit-lane j of the pack loop reads columns
     # {32w + j} as a contiguous row: (4, W32, 32) -> (4, 32, W32)
     cols = boxes_p.T.reshape(4, w32, _PL).transpose(0, 2, 1)
-    thresh = jnp.asarray([iou_thresh], jnp.float32)
-
-    cw = 128                       # col-word tile: 128 lanes = 4096 columns
-    sup = pl.pallas_call(
-        _suppress_kernel,
-        grid=(n_pad // _BR, w32 // cw),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((_BR, 4), lambda r, c: (r, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((_BR, cw), lambda r, c: (r, c),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n_pad, w32), jnp.int32),
-    )(thresh, boxes_p, cols[0], cols[1], cols[2], cols[3])
 
     # 8x8 block-diagonal, bit-packed 2 words per block for SMEM scalar
     # loads: word k of block r, byte j' (col j = 4k + j'), bit i =
@@ -276,6 +275,38 @@ def _nms_core(boxes: jnp.ndarray, scores: jnp.ndarray, valid: jnp.ndarray,
     valid_words = jnp.sum(
         valid_p.astype(jnp.int32).reshape(w32, _PL) <<
         jnp.arange(_PL, dtype=jnp.int32)[None, :], axis=1).reshape(1, w32)
+    return boxes_p, cols, diagp, valid_words
+
+
+def _nms_kernels(boxes_p, cols, diagp, valid_words, *, max_out: int,
+                 iou_thresh: float):
+    """The two Mosaic kernels (phase A + sweep) — the only part the batched
+    rule must run per-image under lax.map."""
+    n_pad = boxes_p.shape[0]
+    w32 = n_pad // _PL
+    thresh = jnp.asarray([iou_thresh], jnp.float32)
+
+    cw = 128                       # col-word tile: 128 lanes = 4096 columns
+    sup = pl.pallas_call(
+        _suppress_kernel,
+        grid=(n_pad // _BR, w32 // cw),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((_BR, 4), lambda r, c: (r, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_PL, cw), lambda r, c: (0, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_BR, cw), lambda r, c: (r, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, w32), jnp.int32),
+    )(thresh, boxes_p, cols[0], cols[1], cols[2], cols[3])
 
     keep_words = pl.pallas_call(
         _sweep_kernel,
@@ -292,7 +323,13 @@ def _nms_core(boxes: jnp.ndarray, scores: jnp.ndarray, valid: jnp.ndarray,
         scratch_shapes=[pltpu.VMEM((1, w32), jnp.int32),
                         pltpu.SMEM((1,), jnp.int32)],
     )(jnp.asarray([max_out], jnp.int32), diagp, sup, valid_words)
+    return keep_words
 
+
+def _nms_post(keep_words, *, n: int, max_out: int):
+    """Unpack the kept-bit words and compact to max_out slots (pure jnp,
+    vmappable)."""
+    n_pad = keep_words.shape[1] * _PL
     # unpack: word w bit j = column 32w + j, C-order reshape restores it
     keep_bits = ((keep_words[0][:, None] >>
                   jnp.arange(_PL, dtype=jnp.int32)[None, :]) & 1)
