@@ -76,6 +76,13 @@ class TrainConfig:
     MOMENTUM: float = 0.9
     WD: float = 0.0005
     CLIP_GRADIENT: float = 5.0
+    # momentum-accumulator storage dtype ("float32" | "bfloat16").  The
+    # update is HBM-bandwidth-bound (every buffer read+written once per
+    # step); bf16 storage halves the momentum traffic.  Update math stays
+    # f32 (the trace is upcast before g + mu*t), params stay f32 master
+    # weights — only the stored trace rounds.  TPU-only knob; no
+    # reference equivalent (MXNet SGD keeps f32 momentum).
+    OPT_ACC_DTYPE: str = "float32"
     WARMUP: bool = False
     WARMUP_LR: float = 0.0
     WARMUP_STEP: int = 0

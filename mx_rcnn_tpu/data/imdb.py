@@ -65,11 +65,30 @@ class IMDB:
         logger.info("%s wrote %s cache to %s", self.name, tag, cache_file)
         return data
 
+    @staticmethod
+    def sanitize_proposals(boxes, width: int, height: int) -> np.ndarray:
+        """Clip external proposals into the image and repair degenerate
+        rows (x2 < x1 / y2 < y1).  Real selective-search releases contain
+        occasional zero-width / out-of-bounds boxes (the reference's
+        merged-roidb flip would trip its assert on them); sanitizing ONCE
+        at attach time keeps original and flipped records on identical
+        geometry instead of special-casing the flip path."""
+        boxes = np.asarray(boxes, dtype=np.float32)
+        if len(boxes) == 0:
+            return boxes.reshape(0, 4)
+        boxes = boxes.copy()
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, width - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, height - 1)
+        boxes[:, 2] = np.maximum(boxes[:, 0], boxes[:, 2])
+        boxes[:, 3] = np.maximum(boxes[:, 1], boxes[:, 3])
+        return boxes
+
     def append_flipped_images(self, roidb: list) -> list:
         """Double the roidb with x-flipped records (reference semantics:
         boxes mirrored on image width; loader flips pixels at read time).
         External proposals attached before flipping (the selective-search
-        path) are mirrored too."""
+        path) are mirrored too — the ``proposals`` key is always copied
+        (possibly empty) so flipped records stay structurally uniform."""
 
         def mirror(boxes, w):
             boxes = boxes.copy()
@@ -86,8 +105,12 @@ class IMDB:
             new = dict(rec)
             new["boxes"] = boxes
             new["flipped"] = True
-            if "proposals" in rec and len(rec["proposals"]):
-                new["proposals"] = mirror(rec["proposals"], rec["width"])
+            if "proposals" in rec:
+                new["proposals"] = mirror(
+                    np.asarray(rec["proposals"], np.float32), rec["width"])
+                assert (len(new["proposals"]) == 0
+                        or (new["proposals"][:, 2] >= new["proposals"][:, 0]).all()), \
+                    "degenerate proposals — attach via sanitize_proposals"
             flipped.append(new)
         logger.info("%s appended %d flipped images", self.name, len(flipped))
         return list(roidb) + flipped

@@ -137,7 +137,8 @@ class PascalVOC(IMDB):
         n = 0  # (truncation vs TRAIN.RPN_POST_NMS_TOP_N is ROIIter's to
         # diagnose — it knows the actual cap and warns on construction)
         for rec, boxes in zip(roidb, box_list):
-            rec["proposals"] = boxes
+            rec["proposals"] = self.sanitize_proposals(
+                boxes, rec["width"], rec["height"])
             n += len(boxes)
         logger.info("%s: attached %d selective-search proposals", self.name, n)
         return roidb

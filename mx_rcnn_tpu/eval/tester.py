@@ -171,11 +171,17 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
 
     if det_cache:
         # fail on an unwritable path BEFORE the inference loop, not after
-        # hours of forward passes
+        # hours of forward passes — probe with a throwaway temp file so a
+        # crash mid-eval can't leave a zero-byte/stale file at det_cache
+        # for tools/reeval.py to trip over
+        if os.path.isdir(det_cache):
+            raise IsADirectoryError(f"det_cache is a directory: {det_cache}")
         d = os.path.dirname(det_cache)
         if d:
             os.makedirs(d, exist_ok=True)
-        open(det_cache, "ab").close()
+        probe = f"{det_cache}.probe.{os.getpid()}"
+        open(probe, "wb").close()
+        os.remove(probe)
 
     all_boxes: List[List] = [[None for _ in range(num_images)]
                              for _ in range(num_classes)]
@@ -223,8 +229,18 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
             logger.info("im_detect: %d/%d  %.3fs/im", done, num_images,
                         (time.time() - t0) / max(done, 1))
     if det_cache:
-        with open(det_cache, "wb") as f:
-            pickle.dump(all_boxes, f, pickle.HIGHEST_PROTOCOL)
+        # write-then-rename so det_cache is only ever complete or absent;
+        # pid-suffixed tmp so concurrent evals can't interleave, unlinked
+        # on failure so a full disk doesn't strand a partial file
+        tmp = f"{det_cache}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(all_boxes, f, pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, det_cache)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
         logger.info("cached detections to %s", det_cache)
     if with_masks:
         return imdb.evaluate_sds(all_boxes, all_masks)

@@ -200,24 +200,19 @@ def _nms_vmappable(max_out: int, iou_thresh: float):
 
     @fn.def_vmap
     def _rule(axis_size, in_batched, boxes, scores, valid):
-        del scores  # selection order is index order (the _nms_core contract)
-        boxes, valid = (
+        boxes, scores, valid = (
             a if b else jnp.broadcast_to(a[None], (axis_size,) + a.shape)
-            for a, b in zip((boxes, valid),
-                            (in_batched[0], in_batched[2]))
+            for a, b in zip((boxes, scores, valid), in_batched)
         )
-        # only the Mosaic kernels need the per-image serial loop (their
-        # SMEM specs can't auto-batch); prep and post are ordinary jnp and
-        # vectorize over the batch.  Measured perf-neutral at B=8 (the
-        # scan's residual cost is kernel sequencing, not glue), but the
-        # scan body stays minimal and the prep/post batch like any jnp op
-        n = boxes.shape[1]
-        prep = jax.vmap(partial(_nms_prep, iou_thresh=iou_thresh))
-        kernels = partial(_nms_kernels, max_out=max_out,
-                         iou_thresh=iou_thresh)
-        post = jax.vmap(partial(_nms_post, n=n, max_out=max_out))
-        keep_words = jax.lax.map(lambda t: kernels(*t), prep(boxes, valid))
-        out = post(keep_words)
+        # The Mosaic kernels can't auto-batch (SMEM specs), so each batch
+        # level becomes one serial lax.map.  The map body calls the
+        # custom_vmap-wrapped fn — NOT _nms_core — so a nested vmap batches
+        # the inner call, re-enters this rule, and gets its own lax.map
+        # instead of pushing batching into the pallas_call (the lowering
+        # failure this rule exists to avoid).  Glue (prep/post) inside vs
+        # outside the scan measured perf-neutral at B=8: the scan's
+        # residual cost is kernel sequencing, not glue.
+        out = jax.lax.map(lambda t: fn(*t), (boxes, scores, valid))
         return out, (True, True)
 
     _VMAP_CACHE[(max_out, iou_thresh)] = fn

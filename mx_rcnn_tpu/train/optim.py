@@ -104,10 +104,13 @@ def make_optimizer(cfg: Config, steps_per_epoch: int, params,
         fixed_prefixes = cfg.network.FIXED_PARAMS
     mask = fixed_param_mask(params, fixed_prefixes)
     schedule = make_lr_schedule(cfg, steps_per_epoch, begin_epoch)
+    acc_dtype = (None if tr.OPT_ACC_DTYPE == "float32"
+                 else jnp.dtype(tr.OPT_ACC_DTYPE))
     inner = optax.chain(
         _clip_elementwise(tr.CLIP_GRADIENT),
         optax.add_decayed_weights(tr.WD),
-        optax.sgd(learning_rate=schedule, momentum=tr.MOMENTUM),
+        optax.sgd(learning_rate=schedule, momentum=tr.MOMENTUM,
+                  accumulator_dtype=acc_dtype),
     )
     labels = jax.tree.map(lambda t: "train" if t else "frozen", mask)
     tx = optax.multi_transform(

@@ -11,6 +11,7 @@ from typing import List
 
 import numpy as np
 
+from mx_rcnn_tpu.data.imdb import IMDB
 from mx_rcnn_tpu.logger import logger
 
 
@@ -23,8 +24,9 @@ def load_proposals(roidb: list, pkl_path: str) -> list:
                          f"{len(roidb)} roidb records")
     n = 0
     for rec, props in zip(roidb, proposals):
-        rec["proposals"] = (np.asarray(props, np.float32)
-                            if props is not None else np.zeros((0, 4), np.float32))
+        rec["proposals"] = IMDB.sanitize_proposals(
+            props if props is not None else np.zeros((0, 4), np.float32),
+            rec["width"], rec["height"])
         n += len(rec["proposals"])
     logger.info("attached %d proposals from %s", n, pkl_path)
     return roidb

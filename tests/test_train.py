@@ -234,3 +234,40 @@ def test_multislice_mesh_validation():
         # explicit data_per_slice smaller than the slice must not silently
         # idle chips (round-1 advisor finding)
         make_multislice_mesh(slices=2, data_per_slice=2)
+
+
+def test_bf16_momentum_accumulator():
+    """TRAIN.OPT_ACC_DTYPE=bfloat16 stores the momentum trace in bf16 (half
+    the optimizer's HBM traffic on the momentum buffers) while params stay
+    f32 master weights and the first-step update matches f32 momentum
+    closely (math is f32; only the stored trace rounds)."""
+    from mx_rcnn_tpu.train import make_optimizer
+
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 0.01), params)
+
+    outs = {}
+    for dtype in ("float32", "bfloat16"):
+        c = cfg.replace(TRAIN=dataclasses.replace(cfg.TRAIN,
+                                                  OPT_ACC_DTYPE=dtype))
+        tx, _, _ = make_optimizer(c, steps_per_epoch=10, params=params)
+        opt_state = tx.init(params)
+        # TWO steps: step 1's trace is zero, so only step 2 reads the
+        # stored (possibly rounded) trace back into g + mu*t
+        updates, opt_state = tx.update(grads, opt_state, params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        outs[dtype] = jax.device_get(updates)
+        traces = [l for l in jax.tree.leaves(opt_state)
+                  if hasattr(l, "dtype") and l.ndim > 0]
+        want = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+        assert traces and all(t.dtype == want for t in traces), dtype
+        # updates (and therefore params) stay f32
+        assert all(u.dtype == jnp.float32
+                   for u in jax.tree.leaves(outs[dtype]))
+
+    flat32 = jax.tree.leaves(outs["float32"])
+    flat16 = jax.tree.leaves(outs["bfloat16"])
+    for a, b in zip(flat32, flat16):
+        np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-6)
