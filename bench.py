@@ -139,6 +139,7 @@ def bench_train_loader(batch: int, network: str = "resnet101"):
     state, step, _, cfg = build(batch, network)
     roidb = _synthetic_roidb()
     loader = AnchorLoader(roidb, cfg, batch, shuffle=True, seed=0)
+    loader.put = jax.device_put  # double-buffer: transfer on prefetch thread
     # warm the jit cache for every bucket the loader can emit
     for b in loader:
         state, m = step(state, b, jax.random.PRNGKey(0))
@@ -213,16 +214,47 @@ def bench_infer_loader(batch: int, network: str = "resnet101"):
     return best
 
 
+def bench_infer_mask(batch: int, network: str = "resnet101_fpn_mask"):
+    """Full Mask R-CNN eval loop (VERDICT round-2 item 6): pred_eval with
+    with_masks=True — forward + per-class NMS + mask chunk drain + 28×28
+    paste + RLE encode + segm scoring, over the synthetic imdb.  Times the
+    second pred_eval call (first warms every jit shape incl. the mask
+    chunks); reports imgs/sec of the WHOLE loop, the number test.py users
+    experience."""
+    from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+    from mx_rcnn_tpu.data.loader import TestLoader
+    from mx_rcnn_tpu.eval.tester import pred_eval
+
+    pred, cfg = build_infer(batch, network)
+    assert cfg.network.HAS_MASK, f"{network} has no mask head"
+    ds = SyntheticDataset(num_images=24, height=600, width=800)
+    roidb = ds.gt_roidb()
+    pred_eval(pred, TestLoader(roidb, cfg, batch_size=batch), ds,
+              with_masks=True)  # warm
+    best = None
+    for _ in range(2):
+        t0 = time.time()
+        pred_eval(pred, TestLoader(roidb, cfg, batch_size=batch), ds,
+                  with_masks=True)
+        best = max(best or 0.0, len(roidb) / (time.time() - t0))
+    return best
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="train",
-                    choices=["train", "loader", "infer", "infer-loader"])
+                    choices=["train", "loader", "infer", "infer-loader",
+                             "infer-mask"])
     ap.add_argument("--batch", type=int, default=1)
-    ap.add_argument("--network", default="resnet101",
+    ap.add_argument("--network", default=None,
                     help="config preset (e.g. resnet101, resnet101_fpn, "
                          "resnet101_fpn_mask); non-default appears in the "
                          "metric name")
     args = ap.parse_args()
+    if args.network is None:
+        # per-mode default: an explicitly passed network is never rewritten
+        args.network = ("resnet101_fpn_mask" if args.mode == "infer-mask"
+                        else "resnet101")
 
     if args.mode == "train":
         value = bench_train_staged(args.batch, args.network)
@@ -233,6 +265,9 @@ def main():
     elif args.mode == "infer":
         value = bench_infer_staged(args.batch, args.network)
         metric = "infer_imgs_per_sec"
+    elif args.mode == "infer-mask":
+        value = bench_infer_mask(args.batch, args.network)
+        metric = "infer_imgs_per_sec_mask_eval"
     else:
         value = bench_infer_loader(args.batch, args.network)
         metric = "infer_imgs_per_sec_loader_inclusive"

@@ -78,11 +78,14 @@ class TrainConfig:
     CLIP_GRADIENT: float = 5.0
     # momentum-accumulator storage dtype ("float32" | "bfloat16").  The
     # update is HBM-bandwidth-bound (every buffer read+written once per
-    # step); bf16 storage halves the momentum traffic.  Update math stays
-    # f32 (the trace is upcast before g + mu*t), params stay f32 master
-    # weights — only the stored trace rounds.  TPU-only knob; no
-    # reference equivalent (MXNet SGD keeps f32 momentum).
-    OPT_ACC_DTYPE: str = "float32"
+    # step); bf16 storage halves the momentum traffic (measured −0.26 ms
+    # device on the classic step).  Update math stays f32 (the trace is
+    # upcast before g + mu*t), params stay f32 master weights — only the
+    # stored trace rounds.  Divergence from MXNet SGD's f32 momentum:
+    # measured neutral on the mini-VOC fixture A/B (BASELINE.md round-3
+    # divergence ledger); set "float32" to restore exact reference
+    # semantics.
+    OPT_ACC_DTYPE: str = "bfloat16"
     WARMUP: bool = False
     WARMUP_LR: float = 0.0
     WARMUP_STEP: int = 0
@@ -186,6 +189,12 @@ class TPUConfig:
     # NOTE: affects numerics; train and eval must use the same value (any
     # consistent generate_config call does).
     ROI_SAMPLING_RATIO: int = 1
+    # RoI pooling reduction over the sampled grid: "avg" (ROIAlign paper /
+    # torchvision) or "max" (closer to the reference's CUDA ROIPooling max
+    # reduction — see ops/roi_align.py:roi_pool).  Identical at
+    # ROI_SAMPLING_RATIO=1 where the grid has one sample per bin; the A/B
+    # ledger in BASELINE.md measures the delta at 2.
+    ROI_MODE: str = "avg"
     # host→device prefetch depth
     PREFETCH: int = 2
 

@@ -76,9 +76,18 @@ class _Prefetcher:
     """Runs a batch-producing generator in a daemon thread with a bounded
     queue (depth = cfg.tpu.PREFETCH).  Closing (or GC of) the iterator stops
     the producer — an abandoned consumer must not leave a thread parked on a
-    full queue pinning batches."""
+    full queue pinning batches.
 
-    def __init__(self, gen, depth: int):
+    ``put``: optional callable applied to each batch ON THE PRODUCER THREAD
+    before it is queued — the device double-buffering hook (round-2 weakness
+    3: preparing host numpy but transferring synchronously inside step
+    dispatch leaves the transfer on the critical path).  ``fit`` installs
+    ``jax.device_put`` (with the mesh sharding when data-parallel) here, so
+    the host→device copy is in flight while the previous step computes;
+    ``device_put`` only enqueues the transfer, so the producer thread never
+    blocks on the device."""
+
+    def __init__(self, gen, depth: int, put=None):
         self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._err = None
         self._stop = threading.Event()
@@ -86,6 +95,8 @@ class _Prefetcher:
         def run():
             try:
                 for item in gen:
+                    if put is not None:
+                        item = put(item)
                     while not self._stop.is_set():
                         try:
                             self._q.put(item, timeout=0.2)
@@ -145,6 +156,10 @@ class AnchorLoader:
         self.cfg = cfg
         self.batch_size = batch_size
         self.shuffle = shuffle
+        # device double-buffering hook: when set (``fit`` installs the
+        # plan-aware device_put), batches arrive on-device, transfer
+        # overlapped with the previous step's compute
+        self.put = None
         self._rng = np.random.RandomState(seed)
         # aspect grouping: horizontal (w>=h) vs vertical image index pools
         self._groups = [
@@ -212,7 +227,8 @@ class AnchorLoader:
 
     def __iter__(self):
         plan = self._epoch_plan()  # RNG on the consumer thread only
-        return iter(_Prefetcher(self._produce(plan), self.cfg.tpu.PREFETCH))
+        return iter(_Prefetcher(self._produce(plan), self.cfg.tpu.PREFETCH,
+                                put=self.put))
 
 
 class TestLoader:
@@ -226,6 +242,10 @@ class TestLoader:
         self.roidb = roidb
         self.cfg = cfg
         self.batch_size = batch_size
+        # double-buffering hook (Predictor.batch_put): transfers the
+        # device-bound keys from the prefetch thread, keeps indices/
+        # batch_valid host-side
+        self.put = None
 
     def __len__(self) -> int:
         n = len(self.roidb)
@@ -246,7 +266,8 @@ class TestLoader:
                 batch["batch_valid"] = np.asarray([True] * len(idx) + [False] * pad)
                 yield batch
 
-        return iter(_Prefetcher(produce(), self.cfg.tpu.PREFETCH))
+        return iter(_Prefetcher(produce(), self.cfg.tpu.PREFETCH,
+                                put=self.put))
 
 
 class ROIIter:
@@ -261,6 +282,7 @@ class ROIIter:
         self._inner = AnchorLoader(roidb, cfg, batch_size, shuffle, seed)
         self.cfg = cfg
         self.batch_size = batch_size
+        self.put = None  # same double-buffering hook as AnchorLoader
         cap = cfg.TRAIN.RPN_POST_NMS_TOP_N
         over = sum(len(r.get("proposals", ())) > cap for r in roidb)
         if over:
@@ -307,4 +329,4 @@ class ROIIter:
                     samples.append(s)
                 yield _stack(samples)
 
-        return iter(_Prefetcher(produce(), cfg.tpu.PREFETCH))
+        return iter(_Prefetcher(produce(), cfg.tpu.PREFETCH, put=self.put))

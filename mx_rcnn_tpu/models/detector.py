@@ -78,7 +78,8 @@ class FasterRCNN(nn.Module):
         sr = self.cfg.tpu.ROI_SAMPLING_RATIO
         pooled = jax.vmap(
             lambda f, r: roi_align(f.astype(self._dtype), r, spatial_scale=scale,
-                                   pooled_size=self._pooled, sampling_ratio=sr)
+                                   pooled_size=self._pooled, sampling_ratio=sr,
+                                   mode=self.cfg.tpu.ROI_MODE)
         )(feat, rois)  # (B, R, P, P, C)
         if isinstance(self.head_body, VGGFC):
             emb = self.head_body(pooled, deterministic=deterministic)

@@ -151,7 +151,8 @@ class FPNFasterRCNN(nn.Module):
             scale = 1.0 / self._strides[li]
             p = jax.vmap(lambda f, r, s=scale: roi_align(
                 f.astype(self._dtype), r, spatial_scale=s, pooled_size=pooled,
-                sampling_ratio=self.cfg.tpu.ROI_SAMPLING_RATIO))(feats[li], rois)
+                sampling_ratio=self.cfg.tpu.ROI_SAMPLING_RATIO,
+                mode=self.cfg.tpu.ROI_MODE))(feats[li], rois)
             sel = (lvl == li).astype(p.dtype)[..., None, None, None]
             acc = p * sel if acc is None else acc + p * sel
         return acc

@@ -185,6 +185,8 @@ def roi_align(
 
     Returns: (R, pooled, pooled, C).
     """
+    if mode not in ("avg", "max"):
+        raise ValueError(f"roi_align mode must be 'avg' or 'max', got {mode!r}")
     if mode == "avg" or sampling_ratio == 1:
         # max == avg at one sample per bin, so the separable path covers it
         return _roi_align_separable(features, rois, spatial_scale,

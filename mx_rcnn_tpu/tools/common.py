@@ -61,6 +61,9 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
         parser.add_argument("--resume", action="store_true")
         parser.add_argument("--batch_images", type=int, default=None,
                             help="GLOBAL images per step (default: 1 per device)")
+        parser.add_argument("--seed", type=int, default=0,
+                            help="train RNG seed (sampling streams + "
+                                 "dropout); loader shuffle uses its own")
         parser.add_argument("--num-steps", type=int, default=0, dest="num_steps",
                             help="cap steps per epoch (smoke runs)")
     else:

@@ -42,6 +42,7 @@ def train_rpn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
     state = fit(cfg, model, params, loader,
                 begin_epoch=args.begin_epoch, end_epoch=args.end_epoch,
                 plan=plan, prefix=getattr(args, "prefix", None), graph="rpn",
+                seed=getattr(args, "seed", 0),
                 frequent=args.frequent, fixed_prefixes=fixed)
     return state
 

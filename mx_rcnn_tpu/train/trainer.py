@@ -4,10 +4,12 @@
 Responsibilities mirrored: per-epoch data iteration, composite metrics,
 Speedometer batch-end callback, do_checkpoint epoch-end callback, resume
 (the reference's ``--resume`` loads the begin_epoch checkpoint and
-continues).  The loader yields host batches; ``shard_batch`` scatters them
-over the mesh (the Module ctx split).  Dispatch is async — metrics are
-fetched one step late so the host never blocks the device on the current
-step's scalars.
+continues).  Batches are transferred (and mesh-scattered — the Module ctx
+split) from the loader's prefetch thread via its ``put`` hook, so the
+host→device copy overlaps the previous step's compute; loaders without
+the hook fall back to a synchronous per-step ``shard_batch``.  Dispatch is
+async — metrics are fetched one step late so the host never blocks the
+device on the current step's scalars.
 """
 
 from __future__ import annotations
@@ -87,6 +89,13 @@ def fit(cfg: Config, model, params, train_loader,
 
     step_fn = make_train_step(model, tx, plan=plan, graph=graph,
                               trainable_mask=mask)
+    # device double-buffering: loaders that expose a ``put`` hook transfer
+    # each batch from their prefetch thread (overlapping the previous
+    # step's compute) instead of synchronously inside step dispatch
+    loader_puts = getattr(train_loader, "put", False) is None
+    if loader_puts:
+        train_loader.put = ((lambda b: shard_batch(plan, b))
+                            if plan is not None else jax.device_put)
     n_chips = plan.n_data if plan else 1
     speedo = Speedometer(train_loader.batch_size, frequent=frequent,
                          n_chips=n_chips)
@@ -109,7 +118,7 @@ def fit(cfg: Config, model, params, train_loader,
                     profiling = False
                     logger.info("wrote device trace to %s", profile_dir)
             key, sub = jax.random.split(key)
-            if plan is not None:
+            if plan is not None and not loader_puts:
                 batch = shard_batch(plan, batch)
             state, metrics = step_fn(state, batch, sub)
             pending = metrics

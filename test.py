@@ -12,7 +12,7 @@ from mx_rcnn_tpu.eval import Predictor, pred_eval
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
-                                      get_imdb, load_eval_params)
+                                      get_imdb, load_eval_params, make_plan)
 
 
 def parse_args():
@@ -31,8 +31,12 @@ def test_rcnn(args):
     roidb = imdb.gt_roidb()
     model = build_model(cfg)
     params = load_eval_params(args, cfg, model)
-    predictor = Predictor(model, params, cfg)
-    loader = TestLoader(roidb, cfg, batch_size=args.batch_images)
+    # data-parallel eval when >1 device: params replicate, batch rows shard
+    # over the mesh (--batch_images stays the per-chip count, like train)
+    plan = make_plan(args)
+    predictor = Predictor(model, params, cfg, plan=plan)
+    bs = args.batch_images * (plan.n_data if plan else 1)
+    loader = TestLoader(roidb, cfg, batch_size=bs)
     stats = pred_eval(predictor, loader, imdb, thresh=args.thresh,
                       vis=args.vis, with_masks=cfg.network.HAS_MASK,
                       det_cache=args.dets_cache or None)

@@ -1,0 +1,58 @@
+"""Mesh-sharded eval (VERDICT round-2 item 8): pred_eval with a data-axis
+``MeshPlan`` must match the single-device loop — the forward is SPMD over
+batch rows, everything after device_get is the same host numpy.  Runs on
+the 8-device virtual CPU mesh (conftest).  f32 compute: the sharded and
+unsharded programs compile to different fusions, and under bf16 that
+rounding jitter blows up through the head softmax (measured 0.007 score
+diffs with random params); in f32 the two programs agree to ~1e-6."""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import SyntheticDataset, TestLoader
+from mx_rcnn_tpu.eval import Predictor, im_detect, pred_eval
+from mx_rcnn_tpu.models import build_model, init_params
+from mx_rcnn_tpu.parallel import make_mesh
+
+
+def tiny_cfg():
+    cfg = generate_config(
+        "resnet50", "PascalVOC",
+        TEST__RPN_PRE_NMS_TOP_N=300, TEST__RPN_POST_NMS_TOP_N=32,
+    )
+    net = dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4))
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((96, 128),), MAX_GT=8,
+                              COMPUTE_DTYPE="float32")
+    return cfg.replace(network=net, tpu=tpu)
+
+
+def test_mesh_eval_matches_single_device():
+    cfg = tiny_cfg()
+    ds = SyntheticDataset(num_images=10, height=96, width=128)
+    roidb = ds.gt_roidb()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (96, 128))
+
+    plan = make_mesh(data=8)
+    single = Predictor(model, params, cfg)
+    sharded = Predictor(model, params, cfg, plan=plan)
+
+    # per-batch forward parity: same rows, mesh vs one device
+    loader = TestLoader(roidb, cfg, batch_size=8)
+    batch = next(iter(loader))
+    d1 = im_detect(single, batch)
+    d8 = im_detect(sharded, sharded.batch_put(batch))
+    assert len(d1) == len(d8) == 8
+    for (s1, b1, v1), (s8, b8, v8) in zip(d1, d8):
+        np.testing.assert_allclose(s1, s8, rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(b1, b8, rtol=2e-5, atol=5e-3)
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v8))
+
+    # full pred_eval through the mesh (batch 8 = one row per device;
+    # 10 images -> padded tail batch exercises batch_valid masking)
+    stats1 = pred_eval(single, TestLoader(roidb, cfg, batch_size=8), ds)
+    stats8 = pred_eval(sharded, TestLoader(roidb, cfg, batch_size=8), ds)
+    assert abs(stats1["mAP"] - stats8["mAP"]) < 1e-6

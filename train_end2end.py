@@ -60,6 +60,7 @@ def train_net(args):
     state = fit(cfg, model, params, loader,
                 begin_epoch=args.begin_epoch, end_epoch=args.end_epoch,
                 plan=plan, prefix=args.prefix, graph="end2end",
+                seed=getattr(args, "seed", 0),
                 frequent=args.frequent, resume=args.resume,
                 profile_dir=getattr(args, "profile", "") or None,
                 fixed_prefixes=cfg.network.FIXED_PARAMS)
