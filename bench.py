@@ -19,8 +19,8 @@ prints one JSON line):
   python bench.py --batch 4              # staged train step at B=4
   python bench.py --mode loader          # loader-INCLUSIVE train: real
       AnchorLoader over a synthetic roidb (cv2 resize, host s2d, prefetch
-      thread, per-step host→device transfer all in the measured loop — the
-      Speedometer-equivalent number)
+      thread with on-thread device transfer — all in the measured loop;
+      the Speedometer-equivalent number)
   python bench.py --mode infer --batch 4 # staged inference (predict chain)
   python bench.py --mode infer-loader    # TestLoader + im_detect loop incl.
       per-image host decode/readback (the test.py loop without class NMS)
@@ -127,7 +127,12 @@ def _synthetic_roidb(n=48):
 def bench_train_loader(batch: int, network: str = "resnet101"):
     """Loader-inclusive: cv2-free synthetic pixels, but the full production
     path otherwise — resize to bucket, host s2d, target padding, prefetch
-    thread, host→device transfer, one jitted step per loader batch.
+    thread, host→device transfer ON the prefetch thread (the round-3
+    double-buffering ``put`` hook, same as ``fit`` installs: the transfer
+    overlaps the previous step instead of landing inside step dispatch),
+    one jitted step per loader batch.  Numbers before round 3 (BASELINE.md
+    "~50 imgs/s" row) were measured under the old synchronous-transfer
+    semantics.
 
     Best-of-4 fenced epochs, mirroring the staged bench's best-of-4 chains:
     on the tunneled chip, a chain whose steps carry fresh host buffers

@@ -93,6 +93,32 @@ def propose(
     return rois, roi_scores, keep_mask
 
 
+def _level_topk(scores: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Exact top-k indices of a flat score vector, shaped to dodge the v5e
+    windowed-TopK emitter bug (see the crash ledger at the call site).
+
+    Two-stage: reshape to (G, n/G) rows, take top-k per row (every global
+    top-k element is in its row's top-k, so the union is a superset), then
+    top-k over the G·k survivors.  Both stages see row lengths far below
+    the crashing (1, 116736) shape.  Order within ties differs from
+    argsort — irrelevant at the call site (candidates are re-sorted
+    jointly).  Falls back to argsort when the vector is too small to
+    split.
+    """
+    n = scores.shape[0]
+    # largest split with whole rows no shorter than k (P2 @ 116736/k=2400
+    # → g=16; P3 @ 29184 → g=8; smaller levels fall back to argsort)
+    g = next((g for g in (16, 8, 4, 2) if n % g == 0 and n // g >= k), 1)
+    if g == 1:
+        return jnp.argsort(-scores)[:k]
+    rows = scores.reshape(g, n // g)
+    v1, i1 = jax.lax.top_k(rows, k)                      # (G, k) per-row
+    base = (jnp.arange(g, dtype=jnp.int32) * (n // g))[:, None]
+    flat_idx = (i1 + base).reshape(-1)                   # (G·k,)
+    _, i2 = jax.lax.top_k(v1.reshape(-1), k)             # exact global k
+    return flat_idx[i2]
+
+
 def propose_fpn(
     level_scores,
     level_deltas,
@@ -126,15 +152,24 @@ def propose_fpn(
         ms = min_size * im_scale
         scores = jnp.where((ws >= ms) & (hs >= ms), scores, -1.0)
         k = min(k_level, scores.shape[0])
-        # argsort instead of lax.top_k: the v5e compiler SIGABRTs on top_k
-        # fused into the full FPN pyramid graph.  Re-verified round 2
-        # (2026-07-30, jax 0.9.0): `F fusion_util.cc:3726 Check failed:
-        # chunk_counts[new_window_dim] == 1 ... TransformWindow: Loop will
-        # not make progress ... f32[1,116736,1]` → SIGABRT.  top_k alone
-        # and the standalone propose compile fine; only the fused pyramid
-        # graph crashes — an XLA:TPU fusion-pass bug, fenced here.  The
-        # argsort costs ~1.0 ms at P2 (profiled); retry on jax upgrades.
-        top_idx = jnp.argsort(-scores)[:k]
+        # argsort instead of lax.top_k — v5e compiler-bug fence, widened in
+        # round 3.  Crash ledger (all in the full FPN train graph; each
+        # works standalone):
+        #   * lax.top_k (round 2, jax 0.9.0): `F fusion_util.cc:3726 Check
+        #     failed: chunk_counts[new_window_dim] == 1 ... TransformWindow
+        #     ... f32[1,116736,1]` → SIGABRT.
+        #   * approx_max_k(recall_target=1.0) (round 3):
+        #     `TopkEmitter::EmitBatchForWindowedR2: Check failed:
+        #     operand.span_size.RawSize() > 0` → SIGABRT.
+        #   * lax.top_k behind jax.lax.optimization_barrier (round 3): same
+        #     span_size check in `TopkEmitter::EmitWindowedR2` — the bug is
+        #     in the windowed TopK emitter itself at this (1, 116736)/
+        #     k=2400 shape, not the fusion pass, so isolation cannot fix
+        #     it.  (assign_anchor's top_k survives because its k=256 takes
+        #     a different emitter path.)
+        # The argsort costs ~1.3 ms at P2; retry the ledger on libtpu/jax
+        # upgrades.
+        top_idx = _level_topk(scores, k)
         cand_boxes.append(boxes[top_idx])
         cand_scores.append(scores[top_idx])
     boxes = jnp.concatenate(cand_boxes, axis=0)
