@@ -11,6 +11,15 @@ same strategy is a named mesh axis:
 * ``model`` — reserved model axis (size 1 in the reference configs; the
   mesh abstraction keeps it open for sharding large backbones / FPN heads —
   an intentional extension point, not a reference capability).
+* ``space`` — spatial-parallel axis (``make_mesh(space=N)``): the image
+  HEIGHT dimension shards over it, so the conv body runs on H-slices with
+  XLA/GSPMD inserting the halo exchanges every 3×3/stride conv needs —
+  the detection analogue of sequence/context parallelism for inputs too
+  large for one chip's HBM (aerial/medical tiles).  Where the graph stops
+  being spatially shardable (the per-image proposal sort/NMS and the RoI
+  head), GSPMD's propagation inserts the gather; compute up to c4 — 90%
+  of the FLOPs (SURVEY §3.5) — stays sharded.  Like ``model``, an
+  extension beyond the reference's DP-only strategy.
 
 Everything here is plain `jax.sharding`; no pmap.  A jitted step whose
 inputs carry these shardings gets its collectives inserted by XLA — the
@@ -45,7 +54,8 @@ class MeshPlan:
 
     @property
     def batch_axes(self) -> tuple:
-        return tuple(n for n in self.mesh.axis_names if n != "model")
+        return tuple(n for n in self.mesh.axis_names
+                     if n not in ("model", "space"))
 
     @property
     def n_data(self) -> int:
@@ -64,6 +74,19 @@ class MeshPlan:
     @property
     def n_model(self) -> int:
         return self.mesh.shape.get("model", 1)
+
+    @property
+    def n_space(self) -> int:
+        return self.mesh.shape.get("space", 1)
+
+    def images(self) -> NamedSharding:
+        """Sharding for image tensors (B, H, W, C) — batch over the batch
+        axes AND height over ``space`` (rows split across chips; GSPMD
+        halo-exchanges the conv borders).  Identical to ``batch()`` when
+        the mesh has no space axis."""
+        if self.n_space <= 1:
+            return self.batch()
+        return NamedSharding(self.mesh, P(self.batch_axes, "space"))
 
     # -- tensor parallelism over the head FCs (model axis > 1) --------------
     # The classic Megatron pairing on the RoI-head MLP, which is where the
@@ -112,31 +135,44 @@ class MeshPlan:
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
               data: Optional[int] = None, model: int = 1,
-              axis_names=("data", "model")) -> MeshPlan:
-    """Build a (data, model) mesh from the visible devices.
+              space: int = 1,
+              axis_names=None) -> MeshPlan:
+    """Build a (data, model[, space]) mesh from the visible devices.
 
-    ``data`` defaults to ``len(devices) // model``.  On a real pod slice,
-    device order from `jax.devices()` keeps ICI neighbours adjacent, so the
-    data axis rides ICI.  For multi-slice jobs use ``make_multislice_mesh``
-    (a leading DCN axis — the reference's `dist_sync` kvstore analogue,
-    which upstream left unscripted; here it is scripted and tested on the
-    virtual mesh).
+    ``data`` defaults to ``len(devices) // (model * space)``.  On a real
+    pod slice, device order from `jax.devices()` keeps ICI neighbours
+    adjacent, so the inner axes ride ICI — ``space`` is innermost because
+    halo exchanges are the most latency-sensitive collective.  For
+    multi-slice jobs use ``make_multislice_mesh`` (a leading DCN axis —
+    the reference's `dist_sync` kvstore analogue, which upstream left
+    unscripted; here it is scripted and tested on the virtual mesh).
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
+    if axis_names is None:
+        axis_names = (("data", "model", "space") if space > 1
+                      else ("data", "model"))
+    elif space > 1 and (len(axis_names) != 3 or axis_names[2] != "space"):
+        # the device grid below is shaped (data, model, space); caller-
+        # supplied names must agree or images silently stop height-sharding
+        raise ValueError(
+            f"space={space} needs axis_names (data, model, 'space'); "
+            f"got {axis_names}")
     if data is None:
-        data = len(devices) // model
-    n = data * model
+        data = len(devices) // (model * space)
+    n = data * model * space
     if n > len(devices):
-        raise ValueError(f"mesh {data}x{model} needs {n} devices, have {len(devices)}")
+        raise ValueError(f"mesh {data}x{model}x{space} needs {n} devices, "
+                         f"have {len(devices)}")
     if n < len(devices):
         # same contract as make_multislice_mesh: an explicit smaller mesh
         # must not silently idle chips — slice the device list yourself
         raise ValueError(
-            f"mesh {data}x{model} uses only {n} of {len(devices)} devices; "
-            "pass devices[:n] explicitly if that is intended")
-    arr = np.asarray(devices).reshape(data, model)
+            f"mesh {data}x{model}x{space} uses only {n} of {len(devices)} "
+            "devices; pass devices[:n] explicitly if that is intended")
+    shape = (data, model, space) if space > 1 else (data, model)
+    arr = np.asarray(devices).reshape(shape)
     return MeshPlan(mesh=Mesh(arr, axis_names))
 
 
@@ -210,6 +246,16 @@ def shard_batch(plan: MeshPlan, batch):
     """Place a host batch (pytree of np arrays, leading axis = batch) onto
     the mesh, split over the data axis — the analogue of Module's
     ``work_load_list`` ctx split, minus the host copy per device: a single
-    `device_put` with a sharding does the scatter."""
+    `device_put` with a sharding does the scatter.  On a spatial mesh the
+    ``images`` entry additionally splits its height rows over ``space``
+    (``MeshPlan.images``)."""
     sh = plan.batch()
+    if isinstance(batch, dict):
+        im_sh = plan.images()
+        return jax.device_put(
+            batch, {k: im_sh if k == "images" else sh for k in batch})
+    if plan.n_space > 1:
+        raise TypeError(
+            "spatial meshes require dict batches (the 'images' key selects "
+            f"the height-sharded placement); got {type(batch).__name__}")
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch)

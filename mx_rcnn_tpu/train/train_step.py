@@ -124,23 +124,33 @@ def make_train_step(model, tx: optax.GradientTransformation,
 
     repl = plan.replicated()
     batch_sh = plan.batch()
-    if plan.n_model > 1:
-        # tensor parallelism over the head FCs (MeshPlan.param_shardings):
-        # the state sharding tree is structural, so build it lazily from
-        # the first state argument and cache the jitted step
+    if plan.n_model > 1 or plan.n_space > 1:
+        # tensor parallelism (MeshPlan.param_shardings on the head FCs)
+        # and/or spatial parallelism (image height over the space axis):
+        # the state sharding tree is structural and the batch sharding
+        # tree depends on the batch's keys, so build both lazily from the
+        # first call and cache the jitted step
         cache = {}
 
         def stepper(state, batch, key):
-            fn = cache.get("fn")
+            # cache keyed on the batch's key set: the spatial in_shardings
+            # are a per-key dict, so a batch gaining/losing an optional
+            # key (gt_masks) must get its own jitted entry, not a pytree
+            # structure mismatch at dispatch
+            ck = frozenset(batch) if plan.n_space > 1 else "fn"
+            fn = cache.get(ck)
             if fn is None:
                 st_sh = plan.state_shardings(state)
+                b_sh = ({k: plan.images() if k == "images" else batch_sh
+                         for k in batch}
+                        if plan.n_space > 1 else batch_sh)
                 fn = jax.jit(
                     step,
-                    in_shardings=(st_sh, batch_sh, repl),
+                    in_shardings=(st_sh, b_sh, repl),
                     out_shardings=(st_sh, repl),
                     donate_argnums=(0,) if donate else (),
                 )
-                cache["fn"] = fn
+                cache[ck] = fn
             return fn(state, batch, key)
 
         return stepper
