@@ -126,7 +126,7 @@ class FasterRCNN(nn.Module):
         )(gt_boxes, gt_valid, im_info, keys[:, 0])
 
         # --- proposals (Proposal op; non-differentiable by contract) ---
-        fg_score = jax.nn.softmax(rpn_cls, axis=-1)[..., 1]
+        fg_score = L.fg_prob(rpn_cls)
         fg_score = jax.lax.stop_gradient(fg_score)
         rpn_bbox_sg = jax.lax.stop_gradient(rpn_bbox)
         rois, _, roi_valid = jax.vmap(
@@ -191,7 +191,7 @@ class FasterRCNN(nn.Module):
         feat = self.backbone(images)
         anchors = self._anchors_for(feat.shape[1], feat.shape[2])
         rpn_cls, rpn_bbox = self.rpn(feat)
-        fg_score = jax.nn.softmax(rpn_cls, axis=-1)[..., 1]
+        fg_score = L.fg_prob(rpn_cls)
         rois, roi_scores, roi_valid = jax.vmap(
             lambda s, d, info: propose(
                 s, d, anchors, info[0], info[1], info[2],
@@ -210,7 +210,7 @@ class FasterRCNN(nn.Module):
         feat = self.backbone(images)
         anchors = self._anchors_for(feat.shape[1], feat.shape[2])
         rpn_cls, rpn_bbox = self.rpn(feat)
-        fg_score = jax.nn.softmax(rpn_cls, axis=-1)[..., 1]
+        fg_score = L.fg_prob(rpn_cls)
         return jax.vmap(
             lambda s, d, info: propose(
                 s, d, anchors, info[0], info[1], info[2],

@@ -194,7 +194,7 @@ class FPNFasterRCNN(nn.Module):
         """Training-config proposals: per-level top-k + joint NMS (non-
         differentiable by the Proposal-op contract)."""
         tr = self.cfg.TRAIN
-        level_scores = [jax.lax.stop_gradient(jax.nn.softmax(c, axis=-1)[..., 1])
+        level_scores = [jax.lax.stop_gradient(L.fg_prob(c))
                         for c, _, _ in levels]
         level_deltas = [jax.lax.stop_gradient(b) for _, b, _ in levels]
         anchors_l = [a for _, _, a in levels]
@@ -288,7 +288,7 @@ class FPNFasterRCNN(nn.Module):
         te = cfg.TEST
         feats = self._pyramid(images)
         levels = self._rpn_over_levels(feats)
-        level_scores = [jax.nn.softmax(c, axis=-1)[..., 1] for c, _, _ in levels]
+        level_scores = [L.fg_prob(c) for c, _, _ in levels]
         level_deltas = [b for _, b, _ in levels]
         anchors_l = [a for _, _, a in levels]
         rois, roi_scores, roi_valid = jax.vmap(
@@ -351,7 +351,7 @@ class FPNFasterRCNN(nn.Module):
         te = self.cfg.TEST
         feats = self._pyramid(images)
         levels = self._rpn_over_levels(feats)
-        level_scores = [jax.nn.softmax(c, axis=-1)[..., 1] for c, _, _ in levels]
+        level_scores = [L.fg_prob(c) for c, _, _ in levels]
         level_deltas = [b for _, b, _ in levels]
         anchors_l = [a for _, _, a in levels]
         return jax.vmap(

@@ -98,3 +98,13 @@ def mask_bce(logits: jnp.ndarray, target: jnp.ndarray,
     num = jnp.sum(per_roi * weight)
     den = jnp.maximum(jnp.sum(weight), 1.0)
     return num / den
+
+
+def fg_prob(cls_logits: jnp.ndarray) -> jnp.ndarray:
+    """``softmax(logits, -1)[..., 1]`` for the K=2 RPN objectness head,
+    computed as ``sigmoid(l1 − l0)`` on (N,)-shaped data — algebraically
+    identical (softmax2[1] = e^{l1}/(e^{l0}+e^{l1})), but avoids every
+    pass over a trailing K=2 axis that wastes 126 of 128 lanes (the same
+    layout tax `_ce_rows` documents)."""
+    logits = cls_logits.astype(jnp.float32)
+    return jax.nn.sigmoid(logits[..., 1] - logits[..., 0])
