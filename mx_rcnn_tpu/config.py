@@ -246,6 +246,13 @@ _NETWORK_PRESETS = {
         FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
         FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta"),
     ),
+    "resnet152": dict(
+        NETWORK="resnet152",
+        HOST_S2D=True,
+        IMAGE_STRIDE=32,
+        FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
+        FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta"),
+    ),
     # FPN shared trunk = backbone stages 1-4 + the neck (lateral*/post* conv
     # names), so alternate-training rounds 2 keep ALL shared features frozen
     "resnet50_fpn": dict(

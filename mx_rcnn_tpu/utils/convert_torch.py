@@ -2,7 +2,7 @@
 
 The reference consumes MXNet ImageNet params (``--pretrained``); with no
 MXNet here, the practical interchange is a torchvision ``state_dict``
-(``resnet{50,101}``, ``vgg16``) saved as .pth — convert offline with this
+(``resnet{50,101,152}``, ``vgg16``) saved as .pth — convert offline with this
 module, then pass the .npz to ``--pretrained`` (tools/common.py overlays it
 onto the init tree by path+shape match).
 
@@ -23,7 +23,8 @@ from typing import Dict
 
 import numpy as np
 
-RESNET_UNITS = {"resnet50": (3, 4, 6, 3), "resnet101": (3, 4, 23, 3)}
+RESNET_UNITS = {"resnet50": (3, 4, 6, 3), "resnet101": (3, 4, 23, 3),
+                "resnet152": (3, 8, 36, 3)}
 
 # torchvision vgg16 features indices of the 13 convs, in block order
 _VGG_CONV_IDX = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28]
@@ -116,7 +117,8 @@ if __name__ == "__main__":
 
     ap = argparse.ArgumentParser(description="torch .pth -> framework .npz")
     ap.add_argument("pth")
-    ap.add_argument("network", choices=["resnet50", "resnet101", "vgg16"])
+    ap.add_argument("network",
+                    choices=["resnet50", "resnet101", "resnet152", "vgg16"])
     ap.add_argument("npz")
     a = ap.parse_args()
     convert_file(a.pth, a.network, a.npz)

@@ -18,7 +18,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import numpy as np
 
-from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.config import generate_config, list_networks
 from mx_rcnn_tpu.data.image import space_to_depth2
 from mx_rcnn_tpu.models import build_model, init_params
 from mx_rcnn_tpu.train import create_train_state, make_train_step
@@ -26,8 +26,7 @@ from mx_rcnn_tpu.train import create_train_state, make_train_step
 assert jax.default_backend() == "tpu", "run on the TPU chip"
 
 H, W, G = 64, 96, 4
-PRESETS = ["vgg16", "resnet50", "resnet101", "resnet50_fpn",
-           "resnet101_fpn", "resnet101_fpn_mask"]
+PRESETS = list_networks()  # every preset — a new one must compile on-chip
 
 
 def tiny_cfg(name):

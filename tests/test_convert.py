@@ -100,5 +100,19 @@ def test_convert_resnet101_covers_model():
     _check("resnet101", convert(fake_resnet_sd("resnet101"), "resnet101"))
 
 
+def test_convert_resnet152_covers_model():
+    _check("resnet152", convert(fake_resnet_sd("resnet152"), "resnet152"))
+
+
+def test_resnet_units_tables_agree():
+    """convert_torch keeps its own RESNET_UNITS so it stays importable in a
+    torch-only env; this pins it to the backbone's table (the two drifted
+    once — resnet152 landed in backbones first)."""
+    from mx_rcnn_tpu.models.backbones import RESNET_UNITS as model_units
+    from mx_rcnn_tpu.utils.convert_torch import RESNET_UNITS as conv_units
+
+    assert conv_units == model_units
+
+
 def test_convert_vgg16_covers_model():
     _check("vgg16", convert(fake_vgg_sd(), "vgg16"))
