@@ -232,27 +232,8 @@ _NETWORK_PRESETS = {
         FIXED_PARAMS_SHARED=("conv1", "conv2", "conv3", "conv4", "conv5"),
         HAS_FPN=False,
     ),
-    "resnet50": dict(
-        NETWORK="resnet50",
-        HOST_S2D=True,
-        IMAGE_STRIDE=32,
-        FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
-        FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta"),
-    ),
-    "resnet101": dict(
-        NETWORK="resnet101",
-        HOST_S2D=True,
-        IMAGE_STRIDE=32,
-        FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
-        FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta"),
-    ),
-    "resnet152": dict(
-        NETWORK="resnet152",
-        HOST_S2D=True,
-        IMAGE_STRIDE=32,
-        FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
-        FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3", "gamma", "beta"),
-    ),
+    # classic resnet presets are generated below — one dict per depth,
+    # identical apart from NETWORK (single source of truth)
     # FPN shared trunk = backbone stages 1-4 + the neck (lateral*/post* conv
     # names), so alternate-training rounds 2 keep ALL shared features frozen
     "resnet50_fpn": dict(
@@ -287,6 +268,16 @@ _NETWORK_PRESETS = {
                              "stage4", "lateral", "post", "gamma", "beta"),
     ),
 }
+
+for _depth in ("resnet50", "resnet101", "resnet152"):
+    _NETWORK_PRESETS[_depth] = dict(
+        NETWORK=_depth,
+        HOST_S2D=True,
+        IMAGE_STRIDE=32,
+        FIXED_PARAMS=("conv1", "bn1", "stage1", "gamma", "beta"),
+        FIXED_PARAMS_SHARED=("conv1", "bn1", "stage1", "stage2", "stage3",
+                             "gamma", "beta"),
+    )
 
 _DATASET_PRESETS = {
     "PascalVOC": dict(

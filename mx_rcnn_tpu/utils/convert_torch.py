@@ -117,8 +117,7 @@ if __name__ == "__main__":
 
     ap = argparse.ArgumentParser(description="torch .pth -> framework .npz")
     ap.add_argument("pth")
-    ap.add_argument("network",
-                    choices=["resnet50", "resnet101", "resnet152", "vgg16"])
+    ap.add_argument("network", choices=sorted(RESNET_UNITS) + ["vgg16"])
     ap.add_argument("npz")
     a = ap.parse_args()
     convert_file(a.pth, a.network, a.npz)

@@ -56,3 +56,35 @@ def test_mesh_eval_matches_single_device():
     stats1 = pred_eval(single, TestLoader(roidb, cfg, batch_size=8), ds)
     stats8 = pred_eval(sharded, TestLoader(roidb, cfg, batch_size=8), ds)
     assert abs(stats1["mAP"] - stats8["mAP"]) < 1e-6
+
+
+def test_mesh_eval_mask_config_runs():
+    """Mask-config pred_eval over the mesh: the sharded predict_with_feats
+    + masks_from_feats path (feats pyramid sharded on batch rows, boxes/
+    labels auto-placed) must run the full chunk-drain + paste + RLE loop
+    and produce the same bbox stats as the single-device loop."""
+    cfg = generate_config(
+        "resnet101_fpn_mask", "PascalVOC",
+        TEST__RPN_PRE_NMS_TOP_N=250, TEST__RPN_POST_NMS_TOP_N=32,
+        TEST__MAX_PER_IMAGE=8,
+    )
+    net = dataclasses.replace(cfg.network, NETWORK="resnet50",
+                              FPN_ANCHOR_SCALES=(4,),
+                              PIXEL_STDS=(127.0, 127.0, 127.0))
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=4,
+                              COMPUTE_DTYPE="float32")
+    cfg = cfg.replace(network=net, tpu=tpu)
+    ds = SyntheticDataset(num_images=4, num_classes=cfg.NUM_CLASSES,
+                          height=64, width=96)
+    roidb = ds.gt_roidb()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+
+    plan = make_mesh(jax.devices()[:4], data=4)
+    stats1 = pred_eval(Predictor(model, params, cfg),
+                       TestLoader(roidb, cfg, batch_size=4), ds,
+                       with_masks=True)
+    stats4 = pred_eval(Predictor(model, params, cfg, plan=plan),
+                       TestLoader(roidb, cfg, batch_size=4), ds,
+                       with_masks=True)
+    assert abs(stats1["bbox"]["mAP"] - stats4["bbox"]["mAP"]) < 1e-6
