@@ -45,7 +45,10 @@ class Predictor:
         if plan is not None:
             params = jax.device_put(params, plan.replicated())
             repl, bsh = plan.replicated(), plan.batch()
-            jit2 = partial(jax.jit, in_shardings=(repl, bsh, bsh))
+            # images() additionally height-shards over a space axis when
+            # the mesh has one (spatial-parallel eval for oversized
+            # inputs); identical to batch() on a (data, model) mesh
+            jit2 = partial(jax.jit, in_shardings=(repl, plan.images(), bsh))
         else:
             bsh = None
             jit2 = jax.jit
@@ -63,9 +66,13 @@ class Predictor:
                 lambda p, images, im_info: model.apply(
                     {"params": p}, images, im_info,
                     method=model.predict_with_feats))
+            # feats sharding is None = inherit from the committed arrays:
+            # on a space mesh the cached pyramid comes out of predict()
+            # height-sharded, and pinning it to batch() here would make
+            # jit reject the mismatch instead of resharding
             mjit = (jax.jit if plan is None else
                     partial(jax.jit,
-                            in_shardings=(plan.replicated(), bsh, bsh, bsh)))
+                            in_shardings=(plan.replicated(), None, bsh, bsh)))
             self._masks_from_feats = mjit(
                 lambda p, feats, boxes, labels: model.apply(
                     {"params": p}, feats, boxes, labels,
@@ -80,7 +87,7 @@ class Predictor:
         device-resident copy would add a blocked d2h round-trip per batch
         (~100-300 ms on the tunnel); jit ships the 12-byte ``im_info``
         per call for free."""
-        sh = self.plan.batch() if self.plan is not None else None
+        sh = self.plan.images() if self.plan is not None else None
         out = dict(batch)
         out["images"] = (jax.device_put(batch["images"], sh)
                          if sh is not None else jax.device_put(batch["images"]))

@@ -88,3 +88,12 @@ def test_mesh_eval_mask_config_runs():
                        TestLoader(roidb, cfg, batch_size=4), ds,
                        with_masks=True)
     assert abs(stats1["bbox"]["mAP"] - stats4["bbox"]["mAP"]) < 1e-6
+
+    # regression (round 3): on a SPACE mesh predict() caches a height-
+    # sharded pyramid; masks_from_feats must inherit that sharding rather
+    # than pin feats to batch() and reject the mismatch at dispatch
+    sp_plan = make_mesh(data=2, space=4)
+    stats_sp = pred_eval(Predictor(model, params, cfg, plan=sp_plan),
+                         TestLoader(roidb, cfg, batch_size=2), ds,
+                         with_masks=True)
+    assert abs(stats1["bbox"]["mAP"] - stats_sp["bbox"]["mAP"]) < 1e-6

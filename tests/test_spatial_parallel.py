@@ -45,3 +45,39 @@ def test_spatial_step_matches_flat_dp():
         losses[name] = run
 
     np.testing.assert_allclose(losses["dp"], losses["dp_sp"], rtol=1e-4)
+
+
+def test_spatial_eval_matches_single_device():
+    """Spatial-parallel eval: Predictor on a (data=2, space=4) mesh (image
+    height sharded, params replicated) must reproduce the single-device
+    im_detect outputs — the oversized-input eval path."""
+    from mx_rcnn_tpu.data import SyntheticDataset, TestLoader
+    from mx_rcnn_tpu.eval import Predictor, im_detect
+
+    cfg = tiny_cfg()
+    cfg = cfg.replace(
+        TEST=dataclasses.replace(cfg.TEST, RPN_PRE_NMS_TOP_N=300,
+                                 RPN_POST_NMS_TOP_N=32),
+        tpu=dataclasses.replace(cfg.tpu, COMPUTE_DTYPE="float32",
+                                SCALES=((64, 96),)))
+    ds = SyntheticDataset(num_images=2, height=64, width=96)
+    roidb = ds.gt_roidb()
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+
+    plan = make_mesh(data=2, space=4)
+    single = Predictor(model, params, cfg)
+    sharded = Predictor(model, params, cfg, plan=plan)
+
+    loader = TestLoader(roidb, cfg, batch_size=2)
+    batch = next(iter(loader))
+    sb = sharded.batch_put(batch)
+    assert "space" in str(sb["images"].sharding.spec), sb["images"].sharding
+    d1 = im_detect(single, batch)
+    dsp = im_detect(sharded, sb)
+    for (s1, b1, v1), (s2, b2, v2) in zip(d1, dsp):
+        np.testing.assert_allclose(s1, s2, rtol=2e-5, atol=2e-6)
+        # 0.02 px: f32 re-association through the halo-exchanged conv path
+        # (measured max 0.006 px on 1/25k coords)
+        np.testing.assert_allclose(b1, b2, rtol=2e-5, atol=2e-2)
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
