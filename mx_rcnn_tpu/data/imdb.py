@@ -106,8 +106,17 @@ class IMDB:
             new["boxes"] = boxes
             new["flipped"] = True
             if "proposals" in rec:
-                new["proposals"] = mirror(
-                    np.asarray(rec["proposals"], np.float32), rec["width"])
+                # re-sanitize here rather than assume every attach path did:
+                # a legacy roidb pickle can carry a plain empty list (shape
+                # (0,)) that would crash mirror's column indexing before the
+                # guiding assert fires.  Written back to the source record
+                # so original and flipped halves stay on identical geometry
+                # (the sanitize-ONCE invariant above).
+                props = self.sanitize_proposals(
+                    rec["proposals"], rec["width"], rec["height"])
+                rec["proposals"] = props
+                new["proposals"] = mirror(props, rec["width"]) if len(props) \
+                    else props
                 assert (len(new["proposals"]) == 0
                         or (new["proposals"][:, 2] >= new["proposals"][:, 0]).all()), \
                     "degenerate proposals — attach via sanitize_proposals"

@@ -129,11 +129,16 @@ def assign_anchor(
 
     # one-hot contraction instead of gt_boxes[argmax_gt]: a (N,) gather
     # from (G, 4) serializes on TPU (profiled 0.38 ms/step at FPN's 155 520
-    # anchors); the (N, G) @ (G, 4) one-hot matmul rides the MXU.  f32
-    # one-hot keeps coordinates exact (0/1 weights select, never round).
+    # anchors); the (N, G) @ (G, 4) one-hot matmul rides the MXU.  The dot
+    # must run at Precision.HIGHEST: the default TPU matmul truncates f32
+    # operands to bf16 before the MXU, which rounds gt coordinates at real
+    # image scales (~1000 px → ulp ≈ 2 px) and corrupts the regression
+    # targets the exact gather used to produce.  The op is (N, G≤100) @
+    # (G, 4) — tiny — so HIGHEST costs nothing measurable.
     onehot_gt = jax.nn.one_hot(argmax_gt, gt_boxes.shape[0],
                                dtype=jnp.float32)
-    matched_gt = onehot_gt @ gt_boxes.astype(jnp.float32)  # (N, 4)
+    matched_gt = jnp.matmul(onehot_gt, gt_boxes.astype(jnp.float32),
+                            precision=jax.lax.Precision.HIGHEST)  # (N, 4)
     bbox_target = bbox_transform(anchors, matched_gt).astype(jnp.float32)
     bbox_target = jnp.where(any_gt, bbox_target, jnp.zeros_like(bbox_target))
     bbox_weight = jnp.where(fg_kept[:, None], 1.0, 0.0).astype(jnp.float32)

@@ -123,7 +123,12 @@ def sample_rois(
     k4 = 4 * num_classes
     col = sampled_label[:, None] * 4 + jnp.arange(4)[None, :]  # (B, 4)
     onehot_cols = jax.nn.one_hot(col, k4, dtype=jnp.float32)  # (B, 4, 4K)
-    bbox_target = jnp.einsum("bf,bfk->bk", raw_target.astype(jnp.float32), onehot_cols)
+    # HIGHEST precision: the default TPU matmul would truncate the f32
+    # normalized deltas (O(1) after /stds) to bf16 before the MXU — the
+    # same rounding assign_anchor's one-hot contraction guards against.
+    bbox_target = jnp.einsum("bf,bfk->bk", raw_target.astype(jnp.float32),
+                             onehot_cols,
+                             precision=jax.lax.Precision.HIGHEST)
     fg_w = (is_fg & (sampled_label > 0)).astype(jnp.float32)[:, None, None]
     bbox_weight = jnp.sum(onehot_cols * fg_w, axis=1)
     bbox_target = bbox_target * bbox_weight

@@ -6,15 +6,16 @@ the reference's f32 CUDA semantics (`roi_pooling.cu`, MXNet symbol graph),
 by running the REAL CLIs (train_end2end.py -> test.py) over the on-disk
 mini-VOC fixture on the attached TPU chip, once per config variant:
 
-  base       bf16 backbone, ROI_SAMPLING_RATIO=1, avg pooling, bf16
-             momentum (the shipped classic config — bf16 momentum became
-             the default after round 3's A/B measured it neutral)
+  base       bf16 backbone, ROI_SAMPLING_RATIO=1, avg pooling, f32
+             momentum (the shipped classic config — f32 momentum is the
+             default again after the round-3 advisor pointed out fixture
+             neutrality cannot bound a real-dataset regression)
   f32_body   tpu__COMPUTE_DTYPE=\"float32\"       — the bf16-backbone divergence
   sr2        tpu__ROI_SAMPLING_RATIO=2        — the 1-sample RoIAlign tradeoff
   sr2_max    sr2 + tpu__ROI_MODE=\"max\"          — bilinear-max (closest to the
              reference's max-reduction ROIPooling) vs avg at the same grid
-  f32_mom    TRAIN__OPT_ACC_DTYPE=\"float32\"     — MXNet-exact f32 momentum
-             (isolates the bf16-momentum default divergence)
+  bf16_mom   TRAIN__OPT_ACC_DTYPE=\"bfloat16\"    — the opt-in bf16 momentum
+             storage (measures the divergence the opt-in would introduce)
 
 Each variant trains the same 6 epochs / seed on 2007_trainval (16 imgs,
 flip->32) and evals held-out 2007_minitest.  Output: one table row per
@@ -53,7 +54,7 @@ VARIANTS = {
     "sr2": ["--cfg", "tpu__ROI_SAMPLING_RATIO=2"],
     "sr2_max": ["--cfg", "tpu__ROI_SAMPLING_RATIO=2",
                 "--cfg", "tpu__ROI_MODE=\"max\""],
-    "f32_mom": ["--cfg", "TRAIN__OPT_ACC_DTYPE=\"float32\""],
+    "bf16_mom": ["--cfg", "TRAIN__OPT_ACC_DTYPE=\"bfloat16\""],
 }
 
 

@@ -18,7 +18,11 @@ from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
 def parse_args():
     parser = argparse.ArgumentParser(description="Test a Faster R-CNN network")
     add_common_args(parser, train=False)
-    parser.add_argument("--batch_images", type=int, default=1)
+    parser.add_argument("--batch_images", type=int, default=0,
+                        help="GLOBAL images per eval step (like train's "
+                             "flag; must divide by the mesh's data "
+                             "dimension).  Default: 1 per data-parallel "
+                             "chip.")
     parser.add_argument("--dets_cache", default="",
                         help="pickle all_boxes here for tools/reeval.py "
                              "(the reference's detections.pkl)")
@@ -32,10 +36,18 @@ def test_rcnn(args):
     model = build_model(cfg)
     params = load_eval_params(args, cfg, model)
     # data-parallel eval when >1 device: params replicate, batch rows shard
-    # over the mesh (--batch_images stays the per-chip count, like train)
+    # over the mesh.  --batch_images is GLOBAL, matching train's flag
+    # semantics (train_end2end.py uses it directly as the step batch);
+    # defaulting it to n_data keeps the common single-flag invocation at
+    # one image per data-parallel chip.
     plan = make_plan(args)
+    n_data = plan.n_data if plan else 1
+    bs = args.batch_images or n_data
+    if bs % n_data:
+        raise ValueError(
+            f"--batch_images {bs} must divide by the mesh's data dimension "
+            f"{n_data} (the flag is GLOBAL images per step, like train)")
     predictor = Predictor(model, params, cfg, plan=plan)
-    bs = args.batch_images * (plan.n_data if plan else 1)
     loader = TestLoader(roidb, cfg, batch_size=bs)
     stats = pred_eval(predictor, loader, imdb, thresh=args.thresh,
                       vis=args.vis, with_masks=cfg.network.HAS_MASK,

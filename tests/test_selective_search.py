@@ -79,6 +79,24 @@ def test_ss_roidb_reorder_flip_and_roiiter(tmp_path):
             "gt_valid"} <= set(batch)
 
 
+def test_flip_handles_unsanitized_empty_proposals(tmp_path):
+    """A legacy roidb record carrying a plain empty list for 'proposals'
+    (never routed through sanitize_proposals, so np.asarray gives shape
+    (0,)) must flip to an empty (0, 4) array, not crash on column
+    indexing (round-3 advisor finding)."""
+    make_mini_voc(str(tmp_path / "VOCdevkit"), n_train=2, n_test=2)
+    imdb = PascalVOC("2007_trainval", str(tmp_path / "data"),
+                     str(tmp_path / "VOCdevkit"))
+    roidb = imdb.gt_roidb()
+    roidb[0]["proposals"] = []          # legacy pickle shape
+    roidb[1]["proposals"] = np.zeros((0,), np.float32)
+    flipped = imdb.append_flipped_images(roidb)
+    # both halves are repaired: the originals are sanitized in place so
+    # original/flipped stay on identical geometry
+    for rec in flipped:
+        assert rec["proposals"].shape == (0, 4)
+
+
 def test_ss_roidb_count_mismatch_raises(tmp_path):
     make_mini_voc(str(tmp_path / "VOCdevkit"), n_train=4, n_test=2)
     imdb = PascalVOC("2007_trainval", str(tmp_path / "data"),
