@@ -34,13 +34,21 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO):
+    src = os.path.join(_DIR, "src", "mxr_native.cpp")
+    stale = (not os.path.exists(_SO)
+             or (os.path.exists(src)
+                 and os.path.getmtime(src) > os.path.getmtime(_SO)))
+    if stale:
         try:
             subprocess.run(["make", "-C", _DIR], check=True,
                            capture_output=True, timeout=120)
         except Exception as e:  # no toolchain → numpy fallback
-            logger.warning("native build failed (%s); using numpy fallbacks", e)
-            return None
+            if not os.path.exists(_SO):
+                logger.warning("native build failed (%s); using numpy "
+                               "fallbacks", e)
+                return None
+            logger.warning("native rebuild failed (%s); using the stale "
+                           "library", e)
     try:
         lib = ctypes.CDLL(_SO)
     except OSError as e:
@@ -55,6 +63,19 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.mxr_nms.argtypes = [
         ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
         ctypes.POINTER(ctypes.c_int64)]
+    try:  # absent only in a stale pre-round-4 .so that failed to rebuild
+        lib.mxr_rle_encode.restype = ctypes.c_int64
+        lib.mxr_rle_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_uint32)]
+        lib.mxr_paste_rle.restype = ctypes.c_int64
+        lib.mxr_paste_rle.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_uint32)]
+    except AttributeError:
+        logger.warning("stale native library has no mask RLE entry points; "
+                       "mask eval uses the host fallbacks")
     lib.mxr_rle_iou.argtypes = [
         ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int64,
@@ -100,6 +121,61 @@ def nms(dets: np.ndarray, thresh: float) -> List[int]:
     cnt = lib.mxr_nms(_fptr(dets), len(dets), thresh,
                       keep.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     return keep[:cnt].tolist()
+
+
+_enc_buf: Optional[np.ndarray] = None  # reused across per-det encode calls
+
+
+def rle_encode_packed(packed: np.ndarray, h: int, w: int) -> List[int]:
+    """Bit-packed transposed mask (Wp, Hp//8) uint8 (ops/mask_paste.py
+    layout) → column-major COCO RLE counts over the true (h, w) frame.
+
+    The C++ encoder streams each column as 64-bit words (the packed layout
+    puts column y-runs in sequential bytes); the numpy fallback unpacks the
+    bits and reuses the oracle encoder — identical counts either way.
+    """
+    global _enc_buf
+    packed = np.ascontiguousarray(packed, np.uint8)
+    hp = packed.shape[1] * 8
+    assert hp % 64 == 0, \
+        f"packed height {hp} must be a multiple of 64 (C++ word streaming)"
+    lib = _load()
+    if lib is None or not hasattr(lib, "mxr_rle_encode"):
+        from mx_rcnn_tpu.eval import mask_rle
+
+        mask = np.unpackbits(packed[:w], axis=-1, bitorder="little")
+        return mask_rle.encode(mask[:, :h].T)["counts"]
+    need = h * w + 1
+    if _enc_buf is None or _enc_buf.size < need:
+        _enc_buf = np.empty(need, np.uint32)
+    n = lib.mxr_rle_encode(
+        packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), hp, h, w,
+        _enc_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    return _enc_buf[:n].tolist()
+
+
+def paste_rle(prob: np.ndarray, box: np.ndarray, h: int, w: int):
+    """(M, M) mask probabilities + original-frame box → full-frame
+    column-major RLE counts, or None when the native library is missing
+    (caller falls back to the cv2 paste_mask oracle).
+
+    Fused C++ paste+RLE: separable bilinear resize streamed column by
+    column with bulk zero spans outside the box — ~10-25 ms/img at the
+    100-detection worst case vs ~150 ms for per-detection cv2 paste, and
+    it only needs the 28×28 probabilities shipped from the device."""
+    global _enc_buf
+    lib = _load()
+    if lib is None or not hasattr(lib, "mxr_paste_rle"):
+        return None
+    prob = np.ascontiguousarray(prob, np.float32)
+    need = h * w + 1
+    if _enc_buf is None or _enc_buf.size < need:
+        _enc_buf = np.empty(need, np.uint32)
+    n = lib.mxr_paste_rle(
+        _fptr(prob), prob.shape[0],
+        float(box[0]), float(box[1]), float(box[2]), float(box[3]), h, w,
+        _enc_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    return _enc_buf[:n].tolist()
 
 
 def _flatten_counts(rles: list):

@@ -1,0 +1,89 @@
+"""On-device Mask R-CNN mask pasting (reference: the host-side cv2 paste in
+``rcnn/core/tester.py``'s mask loop + vendored ``maskApi.c`` RLE encode).
+
+The reference pastes each 28×28 mask probability map into the full image
+frame on host (one cv2.resize + threshold per detection — ~150 ms/img at
+the 100-detection cap) and RLE-encodes in C.  Here the paste is a pair of
+tiny matmuls per detection on the MXU — bilinear resize is separable, so
+``mask = Wy @ prob @ Wx`` with per-box weight matrices built in-graph —
+followed by an in-graph threshold + bit-pack, so a whole batch's masks come
+back in ONE ~packed-bitplane readback and the host only runs the C++ RLE
+encoder (``native.rle_encode_packed``).
+
+Semantics match ``eval.tester.paste_mask`` (the oracle): integer paste
+window [floor(x1), ceil(x2)] × [floor(y1), ceil(y2)], cv2-style half-pixel
+source mapping ``src = (j + 0.5) * M/extent - 0.5`` with border-replicate
+clamping, threshold ``>= 0.5``.
+
+Output layout is TRANSPOSED and bit-packed for the encoder's column-major
+scan: (B, R, Wp, Hp//8) uint8, bit ``y & 7`` of byte ``[x, y >> 3]`` is
+pixel (y, x), LSB-first — so an RLE column read is a sequential byte
+stream.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _axis_weights(lo, hi, npix: int, m: int):
+    """Bilinear paste weights for one axis: (..., npix, m).
+
+    ``lo``/``hi``: box edges (inclusive pixel coordinates, any float) with
+    arbitrary leading batch dims.  Row ``p`` holds the source-bin weights
+    of global pixel ``p``; rows outside the integer paste window are zero.
+    """
+    lo_i = jnp.floor(lo)[..., None]                       # (..., 1)
+    extent = jnp.maximum(jnp.ceil(hi)[..., None] - lo_i + 1.0, 1.0)
+    pix = jnp.arange(npix, dtype=jnp.float32)             # (npix,)
+    j = pix - lo_i                                        # (..., npix)
+    inside = (j >= 0.0) & (j <= extent - 1.0)
+    src = (j + 0.5) * (float(m) / extent) - 0.5
+    i0 = jnp.floor(src)
+    f = src - i0
+    w0 = jax.nn.one_hot(jnp.clip(i0, 0, m - 1).astype(jnp.int32), m,
+                        dtype=jnp.float32) * (1.0 - f)[..., None]
+    w1 = jax.nn.one_hot(jnp.clip(i0 + 1.0, 0, m - 1).astype(jnp.int32), m,
+                        dtype=jnp.float32) * f[..., None]
+    return jnp.where(inside[..., None], w0 + w1, 0.0)     # (..., npix, m)
+
+
+def paste_masks(probs, boxes, hp: int, wp: int, chunk: int = 8):
+    """(B, R, M, M) probabilities + (B, R, 4) original-frame boxes →
+    (B, R, wp, hp//8) packed binary masks in the padded (hp, wp) frame.
+
+    ``hp``/``wp`` are static padded frame dims: hp a multiple of 64 (the
+    encoder streams 64-bit words down columns), wp ≥ image width.  Pixels
+    beyond the true (h, w) are junk the encoder never reads.  ``chunk``
+    bounds peak memory: the (chunk, hp, wp) f32 pasted slab lives only
+    inside one ``lax.map`` step.
+    """
+    assert hp % 64 == 0, hp
+    b, r, m, _ = probs.shape
+    nch = -(-r // chunk)
+    rp = nch * chunk
+    probs = jnp.asarray(probs, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if rp != r:
+        probs = jnp.pad(probs, ((0, 0), (0, rp - r), (0, 0), (0, 0)))
+        boxes = jnp.pad(boxes, ((0, 0), (0, rp - r), (0, 0)))
+    probs = probs.reshape(b, nch, chunk, m, m).transpose(1, 0, 2, 3, 4)
+    boxes = boxes.reshape(b, nch, chunk, 4).transpose(1, 0, 2, 3)
+    bitw = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))  # LSB-first
+
+    def body(args):
+        p, bx = args                                       # (B,C,M,M), (B,C,4)
+        wy = _axis_weights(bx[..., 1], bx[..., 3], hp, m)  # (B,C,hp,M)
+        wx = _axis_weights(bx[..., 0], bx[..., 2], wp, m)  # (B,C,wp,M)
+        # transposed paste: out[w, h] so the pack axis (h) is minor —
+        # HIGHEST precision: f32 accumulate, matching the host oracle
+        pasted = jnp.einsum("bcwn,bcmn,bchm->bcwh", wx, p, wy,
+                            precision=jax.lax.Precision.HIGHEST)
+        bits = (pasted >= 0.5).astype(jnp.uint8)
+        bits = bits.reshape(b, chunk, wp, hp // 8, 8)
+        return jnp.sum(bits * bitw, axis=-1, dtype=jnp.uint8)
+
+    packed = jax.lax.map(body, (probs, boxes))             # (nch,B,C,wp,hb)
+    packed = packed.transpose(1, 0, 2, 3, 4).reshape(b, rp, wp, hp // 8)
+    return packed[:, :r]

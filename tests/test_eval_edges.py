@@ -33,10 +33,19 @@ class StubPredictor:
         return (rois, jnp.ones((B, R), bool), jnp.asarray(self._scores),
                 deltas, None)
 
-    def predict_masks_cached(self, boxes, labels):
+    def predict_masks_cached(self, boxes, labels, token=None):
         self.mask_calls += 1
         B, R = labels.shape
         return np.full((B, R, 28, 28), 0.9, np.float32)
+
+    def predict_masks_packed(self, boxes, labels, orig_boxes, hp, wp,
+                             token=None):
+        # the real device-paste op over the stub's constant probabilities
+        # (cfg.TEST.MASK_PASTE == "device" mode)
+        from mx_rcnn_tpu.ops.mask_paste import paste_masks
+
+        probs = self.predict_masks_cached(boxes, labels, token)
+        return paste_masks(probs, orig_boxes, hp, wp)
 
 
 class StubLoader:
