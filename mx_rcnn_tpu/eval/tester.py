@@ -43,6 +43,9 @@ class Predictor:
         self.cfg = cfg
         self.plan = plan
         if plan is not None:
+            from mx_rcnn_tpu.parallel import check_spatial
+
+            check_spatial(plan, cfg)  # thin-shard guard (mesh.py rationale)
             params = jax.device_put(params, plan.replicated())
             repl, bsh = plan.replicated(), plan.batch()
             # images() additionally height-shards over a space axis when
@@ -117,11 +120,12 @@ class Predictor:
         return self._feats_token
 
     def _check_token(self, token):
-        if token is not None and token != self._feats_token:
+        if token != self._feats_token:
             raise AssertionError(
                 f"stale pyramid cache: predict() was last called on batch "
                 f"{self._feats_token}, not {token}; re-run predict() on "
-                f"the batch whose masks you want")
+                f"the batch whose masks you want (pass "
+                f"predictor.feats_token captured right after predict())")
 
     def predict_rpn(self, images, im_info):
         return self._predict_rpn(self.params, images, im_info)
@@ -134,17 +138,18 @@ class Predictor:
         feats = self._pyramid(images)
         return self._masks_from_feats(self.params, feats, boxes, labels)
 
-    def predict_masks_cached(self, boxes, labels, token=None):
+    def predict_masks_cached(self, boxes, labels, token):
         """Mask branch over the pyramid cached by the immediately preceding
-        ``predict`` — ONLY valid for that same batch (pred_eval's pattern;
-        ``token`` from :attr:`feats_token` pins the call to its batch)."""
+        ``predict`` — ONLY valid for that same batch.  ``token`` (required:
+        capture :attr:`feats_token` right after the ``predict`` call) pins
+        the call to its batch; a reordered caller fails loudly."""
         assert self._masks_from_feats is not None, "model has no mask head"
         assert self._feats is not None, "call predict() on this batch first"
         self._check_token(token)
         return self._masks_from_feats(self.params, self._feats, boxes, labels)
 
     def predict_masks_packed(self, boxes, labels, orig_boxes, hp, wp,
-                             token=None):
+                             token):
         """Mask branch + on-device paste over the cached pyramid: SCALED-
         frame ``boxes`` feed RoIAlign, ORIGINAL-frame ``orig_boxes`` place
         the masks in the padded (hp, wp) original frame.  One fused jit
@@ -455,32 +460,30 @@ def _mask_pass(predictor, batch, dets, all_boxes, all_masks, roidb,
         if use_device:
             packed = np.asarray(jax.device_get(predictor.predict_masks_packed(
                 mboxes, mlabels, morig, hp, wp, token=token)))
-            for b in range(B):
-                for r, (k, i, di) in enumerate(taken[b]):
-                    if all_masks[k][i] is None:
-                        all_masks[k][i] = [None] * len(all_boxes[k][i])
-                    h, w = roidb[i]["height"], roidb[i]["width"]
-                    all_masks[k][i][di] = {
-                        "size": [h, w],
+
+            def rle_for(b, r, box, h, w):
+                return {"size": [h, w],
                         "counts": rle_encode_packed(packed[b, r], h, w)}
         else:
             probs = np.asarray(jax.device_get(
                 predictor.predict_masks_cached(mboxes, mlabels, token=token)),
                 np.float32)
-            for b in range(B):
-                for r, (k, i, di) in enumerate(taken[b]):
-                    if all_masks[k][i] is None:
-                        all_masks[k][i] = [None] * len(all_boxes[k][i])
-                    h, w = roidb[i]["height"], roidb[i]["width"]
-                    box = all_boxes[k][i][di][:4]
-                    counts = (paste_rle(probs[b, r], box, h, w)
-                              if mode == "native" else None)
-                    if counts is not None:
-                        all_masks[k][i][di] = {"size": [h, w],
-                                               "counts": counts}
-                    else:  # "host" mode, or native lib unavailable
-                        all_masks[k][i][di] = encode(
-                            paste_mask(probs[b, r], box, h, w))
+
+            def rle_for(b, r, box, h, w):
+                counts = (paste_rle(probs[b, r], box, h, w)
+                          if mode == "native" else None)
+                if counts is not None:
+                    return {"size": [h, w], "counts": counts}
+                return encode(  # "host" mode, or native lib unavailable
+                    paste_mask(probs[b, r], box, h, w))
+
+        for b in range(B):
+            for r, (k, i, di) in enumerate(taken[b]):
+                if all_masks[k][i] is None:
+                    all_masks[k][i] = [None] * len(all_boxes[k][i])
+                h, w = roidb[i]["height"], roidb[i]["width"]
+                all_masks[k][i][di] = rle_for(b, r, all_boxes[k][i][di][:4],
+                                              h, w)
 
 
 def generate_proposals(predictor: Predictor, test_loader: TestLoader,

@@ -139,6 +139,8 @@ def rle_encode_packed(packed: np.ndarray, h: int, w: int) -> List[int]:
     hp = packed.shape[1] * 8
     assert hp % 64 == 0, \
         f"packed height {hp} must be a multiple of 64 (C++ word streaming)"
+    assert h <= hp and w <= packed.shape[0], \
+        f"frame ({h}, {w}) exceeds packed capacity ({hp}, {packed.shape[0]})"
     lib = _load()
     if lib is None or not hasattr(lib, "mxr_rle_encode"):
         from mx_rcnn_tpu.eval import mask_rle

@@ -8,5 +8,5 @@ multi-slice), batch sharded over data, params replicated, gradient
 all-reduce performed by XLA-inserted collectives.
 """
 
-from mx_rcnn_tpu.parallel.mesh import (MeshPlan, make_mesh,
+from mx_rcnn_tpu.parallel.mesh import (MeshPlan, check_spatial, make_mesh,
                                         make_multislice_mesh, shard_batch)

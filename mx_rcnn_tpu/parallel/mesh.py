@@ -242,6 +242,34 @@ def make_multislice_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return MeshPlan(mesh=Mesh(arr, ("dcn", "data", "model")))
 
 
+def check_spatial(plan: MeshPlan, cfg) -> None:
+    """Reject spatial plans whose height shards would be thinner than a
+    stride-2 conv's halo.
+
+    Round-4 finding (virtual CPU mesh, jax 0.9/XLA): when a height-sharded
+    stride-2 3×3 conv's input has only ONE row per ``space`` shard, the
+    SPMD-partitioned program returns garbage (isolated: a lone conv is
+    fine; inside the ResNet bottleneck composite the output is off by O(1)
+    — an XLA partitioner bug with halos spanning multiple shards, not a
+    rounding effect).  With ≥ 2 rows per shard at every stride-2 input the
+    sharded program matches the flat one to f32 rounding (measured 1e-5
+    on the full FPN pyramid).  The deepest height-sharded stride-2 input
+    is C4 (stride 16) for FPN's stage 5, C3 (stride 8) for the classic
+    body (whose stage 5 runs on pooled RoIs, not the sharded map) — hence:
+    ``min SCALES height >= 2 * stride * n_space``."""
+    if plan.n_space <= 1:
+        return
+    stride = 16 if cfg.network.HAS_FPN else 8
+    min_h = min(int(h) for h, _ in cfg.tpu.SCALES)
+    need = 2 * stride * plan.n_space
+    if min_h < need:
+        raise ValueError(
+            f"space={plan.n_space} needs image height >= {need} "
+            f"(2 rows/shard at the deepest stride-2 conv input, stride "
+            f"{stride}); SCALES has height {min_h}.  Thinner shards hit an "
+            f"XLA SPMD halo miscompile — see parallel/mesh.py:check_spatial")
+
+
 def shard_batch(plan: MeshPlan, batch):
     """Place a host batch (pytree of np arrays, leading axis = batch) onto
     the mesh, split over the data axis — the analogue of Module's

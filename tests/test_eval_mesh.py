@@ -91,8 +91,10 @@ def test_mesh_eval_mask_config_runs():
 
     # regression (round 3): on a SPACE mesh predict() caches a height-
     # sharded pyramid; masks_from_feats must inherit that sharding rather
-    # than pin feats to batch() and reject the mismatch at dispatch
-    sp_plan = make_mesh(data=2, space=4)
+    # than pin feats to batch() and reject the mismatch at dispatch.
+    # space=2: the widest FPN space axis check_spatial admits at H=64
+    # (thin-shard rule, parallel/mesh.py)
+    sp_plan = make_mesh(jax.devices()[:4], data=2, space=2)
     stats_sp = pred_eval(Predictor(model, params, cfg, plan=sp_plan),
                          TestLoader(roidb, cfg, batch_size=2), ds,
                          with_masks=True)

@@ -47,6 +47,67 @@ def test_spatial_step_matches_flat_dp():
     np.testing.assert_allclose(losses["dp"], losses["dp_sp"], rtol=1e-4)
 
 
+def test_fpn_spatial_step_matches_flat_dp():
+    """sp over the PYRAMID graph (round-3 VERDICT weakness 6): P6's extra
+    downsample and the RoI one-hot level select interact with a sharded H
+    axis — exactly where spatial sharding would break if any stage were
+    layout-sensitive.  Same harness as the classic test: (data=2, space=4)
+    must match flat (data=2) on the same global batch, f32."""
+    from tests.test_fpn_mask import batch as fpn_batch, fpn_cfg
+
+    cfg = fpn_cfg()
+    # H=128: the smallest height satisfying check_spatial's thin-shard rule
+    # for FPN at space=4 (C4 = H/16 must keep >= 2 rows per shard)
+    cfg = cfg.replace(tpu=dataclasses.replace(cfg.tpu,
+                                              COMPUTE_DTYPE="float32",
+                                              SCALES=((128, 96),)))
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (128, 96))
+    imgs, im_info, gtb, gtc, gtv = fpn_batch(B=2, H=128)
+    batch = dict(images=np.asarray(imgs), im_info=np.asarray(im_info),
+                 gt_boxes=np.asarray(gtb), gt_classes=np.asarray(gtc),
+                 gt_valid=np.asarray(gtv))
+
+    losses = {}
+    for name, plan in (
+        ("dp", make_mesh(jax.devices()[:2], data=2)),
+        ("dp_sp", make_mesh(data=2, space=4)),
+    ):
+        state, tx, mask = create_train_state(cfg, params, steps_per_epoch=10)
+        step = make_train_step(model, tx, plan=plan, trainable_mask=mask)
+        state = jax.device_put(state, plan.replicated())
+        run = []
+        for i in range(2):
+            sb = shard_batch(plan, batch)
+            if plan.n_space > 1:
+                spec = sb["images"].sharding.spec
+                assert "space" in str(spec), spec
+            state, metrics = step(state, sb, jax.random.PRNGKey(i))
+            run.append(float(jax.device_get(metrics["total_loss"])))
+        losses[name] = run
+
+    np.testing.assert_allclose(losses["dp"], losses["dp_sp"], rtol=1e-4)
+
+
+def test_check_spatial_rejects_thin_shards():
+    """FPN at H=64 over space=4 would put 1 row/shard at stage 5's
+    stride-2 input — the measured XLA SPMD miscompile zone; both fit()
+    and Predictor must refuse the plan loudly."""
+    import pytest
+
+    from mx_rcnn_tpu.parallel import check_spatial
+    from tests.test_fpn_mask import fpn_cfg
+
+    cfg = fpn_cfg()  # SCALES ((64, 96),)
+    plan = make_mesh(data=2, space=4)
+    with pytest.raises(ValueError, match="image height >= 128"):
+        check_spatial(plan, cfg)
+    # classic body (deepest stride-2 input C3 at stride 8): H=64 admits
+    # space=4, and any plan without a space axis is exempt
+    check_spatial(plan, tiny_cfg())
+    check_spatial(make_mesh(jax.devices()[:2], data=2), cfg)
+
+
 def test_spatial_eval_matches_single_device():
     """Spatial-parallel eval: Predictor on a (data=2, space=4) mesh (image
     height sharded, params replicated) must reproduce the single-device
