@@ -253,10 +253,17 @@ def check_spatial(plan: MeshPlan, cfg) -> None:
     — an XLA partitioner bug with halos spanning multiple shards, not a
     rounding effect).  With ≥ 2 rows per shard at every stride-2 input the
     sharded program matches the flat one to f32 rounding (measured 1e-5
-    on the full FPN pyramid).  The deepest height-sharded stride-2 input
-    is C4 (stride 16) for FPN's stage 5, C3 (stride 8) for the classic
-    body (whose stage 5 runs on pooled RoIs, not the sharded map) — hence:
-    ``min SCALES height >= 2 * stride * n_space``."""
+    on the full FPN pyramid).  The invariant is ≥ 2 rows/shard at every
+    stride-2 input **with a spatial window > 1** (i.e. a halo): the
+    deepest such input is C4 (stride 16) for FPN's stage 5, C3 (stride 8)
+    for the classic body (whose stage 5 runs on pooled RoIs, not the
+    sharded map) — hence ``min SCALES height >= 2 * stride * n_space``.
+    FPN's P6 subsample does consume the stride-32 P5 map at 1 row/shard
+    inside this envelope, but it is a 1×1-window stride-2 max_pool
+    (``models/fpn.py``): each output row reads exactly one input row, no
+    halo exchange exists to miscompile, and the H=64 space=2 eval parity
+    test (``tests/test_eval_mesh.py``) runs exactly that 1-row/shard P6
+    shape and matches the flat program."""
     if plan.n_space <= 1:
         return
     stride = 16 if cfg.network.HAS_FPN else 8

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import optax
 
 from mx_rcnn_tpu.config import Config
-from mx_rcnn_tpu.parallel.mesh import MeshPlan
+from mx_rcnn_tpu.parallel.mesh import MeshPlan, check_spatial
 from mx_rcnn_tpu.train.metric import metric_scalars
 from mx_rcnn_tpu.train.optim import make_optimizer
 
@@ -99,6 +99,10 @@ def make_train_step(model, tx: optax.GradientTransformation,
     frozen backward tail entirely (the reference freezes conv1+stage1 —
     ``fixed_param_prefix`` — but still computed those gradients; we don't).
     """
+    if plan is not None:
+        # thin-shard guard at the mechanism level: every spatially-sharded
+        # step (fit, dryrun, direct callers) compiles through here
+        check_spatial(plan, model.cfg)
 
     def step(state: TrainState, batch, key):
         def loss_fn(params):

@@ -62,10 +62,8 @@ def fit(cfg: Config, model, params, train_loader,
     the first epoch (the reference has no profiling subsystem — SURVEY §5
     calls this the free win; view with xprof/tensorboard).
     """
-    if plan is not None:
-        from mx_rcnn_tpu.parallel import check_spatial
-
-        check_spatial(plan, cfg)  # thin-shard guard (mesh.py rationale)
+    # thin-shard guard lives in make_train_step (mechanism level); eval's is
+    # in Predictor.__init__ since it never builds a train step
     steps_per_epoch = train_loader.steps_per_epoch
     state, tx, mask = create_train_state(cfg, params, steps_per_epoch,
                                    begin_epoch=begin_epoch,

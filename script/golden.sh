@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Golden-runway (SURVEY §4 golden-metric reproduction): probe for real
+# VOC/COCO + pretrained weights, convert .pth -> .npz if needed, run every
+# runnable golden recipe, and write GOLDEN.md comparing measured mAP/AP
+# against BASELINE.md's anchors.  Safe to run any time: with nothing on
+# disk it just reports what is missing.
+set -e
+cd "$(dirname "$0")/.."
+python scripts/golden.py "$@"
