@@ -45,10 +45,13 @@ WARMUP = 5
 STEPS = 30
 
 
+CFG_OVERRIDES: dict = {}  # set from --cfg (PATH=VALUE, common.py syntax)
+
+
 def make_cfg(network: str = "resnet101"):
     from mx_rcnn_tpu.config import generate_config
 
-    cfg = generate_config(network, "PascalVOC")
+    cfg = generate_config(network, "PascalVOC", **CFG_OVERRIDES)
     return cfg.replace(network=dataclasses.replace(
         cfg.network, PIXEL_STDS=(127.0, 127.0, 127.0)))
 
@@ -255,7 +258,15 @@ def main():
                     help="config preset (e.g. resnet101, resnet101_fpn, "
                          "resnet101_fpn_mask); non-default appears in the "
                          "metric name")
+    ap.add_argument("--cfg", action="append", default=[],
+                    help="config override PATH=VALUE (python literal; "
+                         "common.py syntax), e.g. "
+                         "--cfg TRAIN__RPN_ASSIGN_IOU_BF16=True — for "
+                         "A/B step-time measurements of ledger levers")
     args = ap.parse_args()
+    from mx_rcnn_tpu.tools.common import parse_cfg_overrides
+
+    CFG_OVERRIDES.update(parse_cfg_overrides(args.cfg))
     if args.network is None:
         # per-mode default: an explicitly passed network is never rewritten
         args.network = ("resnet101_fpn_mask" if args.mode == "infer-mask"
@@ -280,9 +291,12 @@ def main():
         metric += f"_b{args.batch}"
     if args.network != "resnet101":
         metric += f"_{args.network}"
+    if args.cfg:
+        metric += "_ab"  # overridden config: never a headline number
 
     vs = None
-    if args.mode == "train" and args.batch == 1 and args.network == "resnet101":
+    if (args.mode == "train" and args.batch == 1
+            and args.network == "resnet101" and not args.cfg):
         if os.path.exists(BASELINE_FILE):
             with open(BASELINE_FILE) as f:
                 base = json.load(f)["value"]

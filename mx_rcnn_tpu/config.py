@@ -61,6 +61,15 @@ class TrainConfig:
     RPN_NEGATIVE_OVERLAP: float = 0.3
     RPN_CLOBBER_POSITIVES: bool = False
     RPN_ALLOWED_BORDER: int = 0
+    # Opt-in: store the (N, G) anchor-IoU matrix in bf16 before its three
+    # reduction passes (max/argmax per anchor, max per gt), halving the HBM
+    # traffic that dominates assign cost at FPN's 155 520 anchors.  IoU is
+    # still COMPUTED in f32 (the cast fuses into the producer); only the
+    # stored matrix and the 0.7/0.3 threshold comparisons round to bf16
+    # (~3 decimal digits → marginal anchors near the thresholds may flip
+    # label, a statistical not systematic change).  Divergence-ledger
+    # treatment (BASELINE.md): default OFF = exact reference semantics.
+    RPN_ASSIGN_IOU_BF16: bool = False
 
     # RPN proposal generation (training-time Proposal op params)
     CXX_PROPOSAL: bool = True  # reference flag name; here: use Pallas kernel

@@ -52,7 +52,7 @@ def _keep_topk_random(mask: jnp.ndarray, k, key, k_cap: int) -> jnp.ndarray:
 
 @partial(jax.jit, static_argnames=("batch_size", "fg_fraction",
                                    "pos_overlap", "neg_overlap", "allowed_border",
-                                   "clobber_positives"))
+                                   "clobber_positives", "iou_bf16"))
 def assign_anchor(
     anchors: jnp.ndarray,
     gt_boxes: jnp.ndarray,
@@ -67,6 +67,7 @@ def assign_anchor(
     neg_overlap: float = 0.3,
     allowed_border: int = 0,
     clobber_positives: bool = False,
+    iou_bf16: bool = False,
 ):
     """Compute RPN labels/targets for one image.
 
@@ -94,7 +95,16 @@ def assign_anchor(
 
     # IoU against padded gt; invalid gt columns masked to -1 so they never win
     overlaps = bbox_overlaps(anchors, gt_boxes)  # (N, G)
-    overlaps = jnp.where(gt_valid[None, :], overlaps, -1.0)
+    if iou_bf16:
+        # cfg.TRAIN.RPN_ASSIGN_IOU_BF16: the (N, G) matrix is read three
+        # times by the reductions below (max/argmax axis 1, max axis 0) —
+        # at FPN's 155 520 anchors that traffic dominates assign cost.
+        # Storing it bf16 halves the bytes; IoU is still computed in f32
+        # (the cast fuses into the producer pass), so only the stored
+        # values and the threshold comparisons round (see config.py).
+        overlaps = overlaps.astype(jnp.bfloat16)
+    overlaps = jnp.where(gt_valid[None, :], overlaps,
+                         jnp.asarray(-1.0, overlaps.dtype))
 
     any_gt = jnp.any(gt_valid)
     max_overlap = jnp.max(overlaps, axis=1)  # (N,)

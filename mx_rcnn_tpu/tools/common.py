@@ -74,11 +74,14 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
     return parser
 
 
-def config_from_args(args, train: bool = True) -> Config:
-    overrides = {}
+def parse_cfg_overrides(items) -> dict:
+    """``--cfg PATH=VALUE`` (python-literal) → overrides dict.  Shared by
+    the CLI drivers, bench.py and scripts/profile_step.py so the syntax
+    and error messages stay identical everywhere."""
     import ast
 
-    for item in getattr(args, "cfg", []) or []:
+    overrides = {}
+    for item in items or []:
         key, _, val = item.partition("=")
         if not _:
             raise ValueError(f"--cfg expects PATH=VALUE, got '{item}'")
@@ -89,6 +92,11 @@ def config_from_args(args, train: bool = True) -> Config:
                 f"--cfg {key}: value {val!r} is not a python literal "
                 f"(strings need quotes, e.g. --cfg dataset__IMAGE_SET="
                 f"'\"2007_trainval\"'): {e}") from None
+    return overrides
+
+
+def config_from_args(args, train: bool = True) -> Config:
+    overrides = parse_cfg_overrides(getattr(args, "cfg", []))
     if train:
         if args.lr is not None:
             overrides["TRAIN__LR"] = args.lr

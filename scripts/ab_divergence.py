@@ -55,6 +55,9 @@ VARIANTS = {
     "sr2_max": ["--cfg", "tpu__ROI_SAMPLING_RATIO=2",
                 "--cfg", "tpu__ROI_MODE=\"max\""],
     "bf16_mom": ["--cfg", "TRAIN__OPT_ACC_DTYPE=\"bfloat16\""],
+    # round-4: bf16 storage of the RPN assign IoU matrix (the FPN-floor
+    # lever — threshold-marginal anchors may flip label)
+    "bf16_iou": ["--cfg", "TRAIN__RPN_ASSIGN_IOU_BF16=True"],
 }
 
 

@@ -85,7 +85,7 @@ def _runnable(name, avail):
     c = GOLDEN[name]
     ds_key = "voc07" if c["dataset"] == "PascalVOC" else "coco"
     return avail["datasets"].get(ds_key) and (
-        avail["weights"].get(c["network"]) is not None)
+        avail["weights"].get(c["torch_name"]) is not None)
 
 
 # ---------------------------------------------------------------------------
@@ -98,20 +98,21 @@ def probe(root: str, model_dir: str) -> dict:
     coco_ok = all(os.path.exists(os.path.join(
         coco_ann, f"instances_{s}.json")) for s in ("train2017", "val2017"))
 
+    # keyed by torch_name: the converted npz depends only on the backbone
+    # (resnet101 serves classic, fpn and mask configs alike)
     weights = {}
-    for net, torch_name in sorted({(c["network"], c["torch_name"])
-                                   for c in GOLDEN.values()}):
-        npz = os.path.join(model_dir, f"{net}_imagenet.npz")
+    for torch_name in sorted({c["torch_name"] for c in GOLDEN.values()}):
+        npz = os.path.join(model_dir, f"{torch_name}_imagenet.npz")
         if os.path.exists(npz):
-            weights[net] = ("npz", npz)
+            weights[torch_name] = ("npz", npz)
             continue
         pths = sorted(glob.glob(os.path.join(model_dir, torch_name + "*.pth")))
-        weights[net] = ("pth", pths[0]) if pths else None
+        weights[torch_name] = ("pth", pths[0]) if pths else None
     return {"datasets": {"voc07": voc_ok, "coco": coco_ok},
             "weights": weights}
 
 
-def ensure_npz(net: str, kind_path, model_dir: str, torch_name: str) -> str:
+def ensure_npz(torch_name: str, kind_path, model_dir: str) -> str:
     """Return a ready .npz path, converting a found .pth if that is all
     there is (reference interchange: MXNet params; ours: torchvision)."""
     kind, path = kind_path
@@ -119,10 +120,9 @@ def ensure_npz(net: str, kind_path, model_dir: str, torch_name: str) -> str:
         return path
     from mx_rcnn_tpu.utils.convert_torch import convert_file
 
-    npz = os.path.join(model_dir, f"{net}_imagenet.npz")
-    base = "vgg16" if net == "vgg16" else torch_name
+    npz = os.path.join(model_dir, f"{torch_name}_imagenet.npz")
     print(f"[golden] converting {path} -> {npz}")
-    convert_file(path, base, npz)
+    convert_file(path, torch_name, npz)
     return npz
 
 
@@ -160,8 +160,8 @@ def _score(stats: dict, cfg: dict, classes=None) -> float:
 def run_config(name: str, avail: dict, args, extra_cfg=(), extra_train=(),
                extra_test=(), classes=None) -> dict:
     c = GOLDEN[name]
-    npz = ensure_npz(c["network"], avail["weights"][c["network"]],
-                     args.model_dir, c["torch_name"])
+    npz = ensure_npz(c["torch_name"], avail["weights"][c["torch_name"]],
+                     args.model_dir)
     prefix = os.path.join(args.model_dir, f"golden_{name}")
     common = ["--network", c["network"], "--dataset", c["dataset"],
               "--root_path", args.root,
@@ -274,8 +274,10 @@ def main(argv=None):
     ap.add_argument("--config", default="",
                     help="run just this GOLDEN config")
     ap.add_argument("--probe-only", action="store_true")
-    ap.add_argument("--devices", type=int, default=0,
-                    help="data-parallel devices (0 = single)")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="data-parallel devices (1 = single chip; the "
+                         "golden recipes use batch_images=1, so pass "
+                         "--devices N only with a matching batch)")
     ap.add_argument("--fixture", default="",
                     help="rehearsal mode: build mini fixtures under this "
                          "dir and run the identical path")

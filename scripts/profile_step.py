@@ -1,6 +1,13 @@
 #!/usr/bin/env python
-"""Profile the flagship bench train step (device time, per-op families)."""
+"""Profile a bench train step (device time, per-op families).
 
+  python scripts/profile_step.py                      # classic resnet101
+  python scripts/profile_step.py --network vgg16      # VGG16 ledger run
+  python scripts/profile_step.py --network resnet101_fpn \
+      --cfg TRAIN__RPN_ASSIGN_IOU_BF16=True           # lever A/B
+"""
+
+import argparse
 import glob
 import os
 import shutil
@@ -14,9 +21,20 @@ import jax
 import bench
 from parse_xplane import main as print_xplane
 
-REPEAT = 10
+ap = argparse.ArgumentParser()
+ap.add_argument("--network", default="resnet101")
+ap.add_argument("--batch", type=int, default=1)
+ap.add_argument("--repeat", type=int, default=10)
+ap.add_argument("--topn", type=int, default=40)
+ap.add_argument("--cfg", action="append", default=[],
+                help="config override PATH=VALUE (python literal)")
+ap.add_argument("--dir", default="/tmp/prof_step")
+args = ap.parse_args()
+from mx_rcnn_tpu.tools.common import parse_cfg_overrides
 
-state, step, batch, _ = bench.build()
+bench.CFG_OVERRIDES.update(parse_cfg_overrides(args.cfg))
+
+state, step, batch, _ = bench.build(args.batch, args.network)
 batch = jax.device_put(batch)
 key = jax.random.PRNGKey(7)
 
@@ -24,13 +42,13 @@ for _ in range(3):
     state, metrics = step(state, batch, key)
 jax.block_until_ready(metrics)
 
-d = "/tmp/prof_step"
-shutil.rmtree(d, ignore_errors=True)
-with jax.profiler.trace(d):
-    for _ in range(REPEAT):
+shutil.rmtree(args.dir, ignore_errors=True)
+with jax.profiler.trace(args.dir):
+    for _ in range(args.repeat):
         state, metrics = step(state, batch, key)
     jax.block_until_ready(metrics)
 
-pb = glob.glob(f"{d}/plugins/profile/*/*.xplane.pb")[0]
-print(f"(sums over {REPEAT} calls)")
-print_xplane(pb, topn=40)
+pb = glob.glob(f"{args.dir}/plugins/profile/*/*.xplane.pb")[0]
+print(f"(sums over {args.repeat} calls, network={args.network}, "
+      f"cfg={args.cfg})")
+print_xplane(pb, topn=args.topn)

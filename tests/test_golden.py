@@ -34,7 +34,7 @@ def test_probe_finds_pth_and_converts(tmp_path):
     avail = probe(str(tmp_path / "data"), str(model_dir))
     kind, path = avail["weights"]["vgg16"]
     assert kind == "pth"
-    npz = ensure_npz("vgg16", (kind, path), str(model_dir), "vgg16")
+    npz = ensure_npz("vgg16", (kind, path), str(model_dir))
     data = np.load(npz)
     assert "backbone/conv1_1/kernel" in data.files
     assert data["head_body/fc6/kernel"].shape == (25088, 4096)
