@@ -123,7 +123,8 @@ class FasterRCNN(nn.Module):
                 pos_overlap=tr.RPN_POSITIVE_OVERLAP, neg_overlap=tr.RPN_NEGATIVE_OVERLAP,
                 allowed_border=tr.RPN_ALLOWED_BORDER,
                 clobber_positives=tr.RPN_CLOBBER_POSITIVES,
-                iou_bf16=tr.RPN_ASSIGN_IOU_BF16)
+                iou_bf16=tr.RPN_ASSIGN_IOU_BF16,
+                fused=self.cfg.tpu.ASSIGN_FUSED)
         )(gt_boxes, gt_valid, im_info, keys[:, 0])
 
         # --- proposals (Proposal op; non-differentiable by contract) ---
@@ -236,7 +237,8 @@ class FasterRCNN(nn.Module):
                 pos_overlap=tr.RPN_POSITIVE_OVERLAP, neg_overlap=tr.RPN_NEGATIVE_OVERLAP,
                 allowed_border=tr.RPN_ALLOWED_BORDER,
                 clobber_positives=tr.RPN_CLOBBER_POSITIVES,
-                iou_bf16=tr.RPN_ASSIGN_IOU_BF16)
+                iou_bf16=tr.RPN_ASSIGN_IOU_BF16,
+                fused=self.cfg.tpu.ASSIGN_FUSED)
         )(gt_boxes, gt_valid, im_info, keys)
         rpn_cls_loss = L.softmax_ce_ignore(rpn_cls, assign["label"])
         rpn_bbox_loss = L.smooth_l1(rpn_bbox, assign["bbox_target"],

@@ -180,7 +180,8 @@ class FPNFasterRCNN(nn.Module):
                 neg_overlap=tr.RPN_NEGATIVE_OVERLAP,
                 allowed_border=tr.RPN_ALLOWED_BORDER,
                 clobber_positives=tr.RPN_CLOBBER_POSITIVES,
-                iou_bf16=tr.RPN_ASSIGN_IOU_BF16)
+                iou_bf16=tr.RPN_ASSIGN_IOU_BF16,
+                fused=self.cfg.tpu.ASSIGN_FUSED)
         )(gt_boxes, gt_valid, im_info, keys)
         rpn_cls_loss = L.softmax_ce_ignore(all_cls, assign["label"])
         rpn_bbox_loss = L.smooth_l1(all_bbox, assign["bbox_target"],

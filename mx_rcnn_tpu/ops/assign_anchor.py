@@ -52,7 +52,8 @@ def _keep_topk_random(mask: jnp.ndarray, k, key, k_cap: int) -> jnp.ndarray:
 
 @partial(jax.jit, static_argnames=("batch_size", "fg_fraction",
                                    "pos_overlap", "neg_overlap", "allowed_border",
-                                   "clobber_positives", "iou_bf16"))
+                                   "clobber_positives", "iou_bf16", "fused",
+                                   "_fused_interpret"))
 def assign_anchor(
     anchors: jnp.ndarray,
     gt_boxes: jnp.ndarray,
@@ -68,6 +69,8 @@ def assign_anchor(
     allowed_border: int = 0,
     clobber_positives: bool = False,
     iou_bf16: bool = False,
+    fused: bool = True,
+    _fused_interpret: bool = False,
 ):
     """Compute RPN labels/targets for one image.
 
@@ -93,29 +96,46 @@ def assign_anchor(
         & (anchors[:, 3] < im_h + allowed_border)
     )
 
-    # IoU against padded gt; invalid gt columns masked to -1 so they never win
-    overlaps = bbox_overlaps(anchors, gt_boxes)  # (N, G)
-    if iou_bf16:
-        # cfg.TRAIN.RPN_ASSIGN_IOU_BF16: the (N, G) matrix is read three
-        # times by the reductions below (max/argmax axis 1, max axis 0) —
-        # at FPN's 155 520 anchors that traffic dominates assign cost.
-        # Storing it bf16 halves the bytes; IoU is still computed in f32
-        # (the cast fuses into the producer pass), so only the stored
-        # values and the threshold comparisons round (see config.py).
-        overlaps = overlaps.astype(jnp.bfloat16)
-    overlaps = jnp.where(gt_valid[None, :], overlaps,
-                         jnp.asarray(-1.0, overlaps.dtype))
-
     any_gt = jnp.any(gt_valid)
-    max_overlap = jnp.max(overlaps, axis=1)  # (N,)
-    argmax_gt = jnp.argmax(overlaps, axis=1)  # (N,)
+    use_kernel = (fused and not iou_bf16 and gt_boxes.shape[0] <= 128
+                  and (_fused_interpret or jax.default_backend() == "tpu"))
+    if use_kernel:
+        # cfg.tpu.ASSIGN_FUSED (default): fused Pallas reductions — IoU
+        # recomputed on the fly per tile, the (N, G) matrix never touches
+        # HBM (~100× less traffic at FPN's 155 520 anchors); semantics
+        # bit-identical to the dense path below (kernels/assign_pallas.py)
+        from mx_rcnn_tpu.kernels.assign_pallas import assign_reduce_pallas
 
-    # per-gt max over *inside* anchors; an anchor tying the per-gt max is fg
-    ov_inside = jnp.where(inside[:, None], overlaps, -1.0)
-    gt_max = jnp.max(ov_inside, axis=0)  # (G,)
-    is_gt_argmax = jnp.any(
-        (ov_inside == gt_max[None, :]) & gt_valid[None, :] & (gt_max[None, :] > 0), axis=1
-    )
+        max_overlap, argmax_gt, gt_max, is_gt_argmax = assign_reduce_pallas(
+            anchors, gt_boxes, gt_valid, inside,
+            interpret=_fused_interpret)
+    else:
+        # IoU against padded gt; invalid columns masked to -1 so they
+        # never win
+        overlaps = bbox_overlaps(anchors, gt_boxes)  # (N, G)
+        if iou_bf16:
+            # cfg.TRAIN.RPN_ASSIGN_IOU_BF16: the (N, G) matrix is read
+            # three times by the reductions below (max/argmax axis 1, max
+            # axis 0) — at FPN's 155 520 anchors that traffic dominates
+            # assign cost.  Storing it bf16 halves the bytes; IoU is still
+            # computed in f32 (the cast fuses into the producer pass), so
+            # only the stored values and the threshold comparisons round
+            # (see config.py).
+            overlaps = overlaps.astype(jnp.bfloat16)
+        overlaps = jnp.where(gt_valid[None, :], overlaps,
+                             jnp.asarray(-1.0, overlaps.dtype))
+
+        max_overlap = jnp.max(overlaps, axis=1)  # (N,)
+        argmax_gt = jnp.argmax(overlaps, axis=1)  # (N,)
+
+        # per-gt max over *inside* anchors; an anchor tying the per-gt max
+        # is fg
+        ov_inside = jnp.where(inside[:, None], overlaps, -1.0)
+        gt_max = jnp.max(ov_inside, axis=0)  # (G,)
+        is_gt_argmax = jnp.any(
+            (ov_inside == gt_max[None, :]) & gt_valid[None, :]
+            & (gt_max[None, :] > 0), axis=1
+        )
 
     fg = (max_overlap >= pos_overlap) | is_gt_argmax
     bg = max_overlap < neg_overlap

@@ -87,6 +87,54 @@ for b in range(3):
         fails += 1
         print(f"MISMATCH [vmap b={b}]: kept {km_b[b].sum()} vs {km_r.sum()}")
 
+# ---- fused assign-IoU reductions (kernels/assign_pallas.py) ------------
+# ULP-level parity contract (see kernel docstring): floats to ~2 ulp,
+# discrete outputs exact away from ULP-boundaries.
+from mx_rcnn_tpu.kernels.assign_pallas import assign_reduce_pallas
+from mx_rcnn_tpu.ops.anchors import all_anchors, generate_anchors
+from mx_rcnn_tpu.ops.boxes import bbox_overlaps
+
+ULP = 3e-7
+for fh, fw, stride, n_gt, seed in ((38, 64, 16, 20, 0), (38, 64, 16, 0, 1),
+                                   (152, 256, 4, 50, 2)):
+    rng = np.random.RandomState(seed)
+    anchors = all_anchors(fh, fw, stride, generate_anchors())
+    im_h, im_w = fh * stride, fw * stride
+    gt = np.zeros((100, 4), np.float32)
+    for i in range(n_gt):
+        x1, y1 = rng.rand(2) * np.array([im_w - 200, im_h - 200])
+        gt[i] = [x1, y1, x1 + 20 + rng.rand() * 160, y1 + 20 + rng.rand() * 160]
+    valid = np.arange(100) < n_gt
+    inside = ((anchors[:, 0] >= 0) & (anchors[:, 1] >= 0)
+              & (anchors[:, 2] < im_w) & (anchors[:, 3] < im_h))
+    ov = np.asarray(bbox_overlaps(jnp.asarray(anchors), jnp.asarray(gt)))
+    ov = np.where(valid[None, :], ov, -1.0)
+    mx, am = ov.max(1), ov.argmax(1)
+    ov_in = np.where(inside[:, None], ov, -1.0)
+    gm = ov_in.max(0)
+    tie = ((ov_in == gm[None, :]) & valid[None, :] & (gm[None, :] > 0)).any(1)
+    k_mx, k_am, k_gm, k_tie = jax.device_get(assign_reduce_pallas(
+        jnp.asarray(anchors), jnp.asarray(gt), jnp.asarray(valid),
+        jnp.asarray(inside)))
+    # distances over VALID columns only — padded columns' -1.0 sentinels
+    # sit at distance 0 of gm and would mark every anchor marginal,
+    # making the discrete checks vacuous (test_assign_sample.py pitfall)
+    near_tie = (np.abs(ov[:, valid] - ov.max(1, keepdims=True))
+                < ULP).sum(1) > 1
+    near_gm = ((np.abs(ov[:, valid] - gm[valid][None, :]) < ULP).any(1)
+               if valid.any() else np.zeros(ov.shape[0], bool))
+    marginal = near_tie | near_gm
+    ok = (np.allclose(k_mx, mx, rtol=0, atol=ULP)
+          and np.allclose(k_gm, gm, rtol=0, atol=ULP)
+          and not ((k_am != am) & ~marginal).any()
+          and not ((k_tie != tie) & ~marginal).any())
+    if not ok:
+        fails += 1
+        print(f"MISMATCH [assign fh={fh} n_gt={n_gt}]: "
+              f"mx {np.abs(k_mx - mx).max():.2e} "
+              f"am {((k_am != am) & ~marginal).sum()} "
+              f"tie {((k_tie != tie) & ~marginal).sum()}")
+
 print("equivalence:", "FAIL" if fails else "OK")
 
 # timing (chained, fence by readback)
@@ -102,5 +150,43 @@ for name, f in (("pallas", lambda: nms_pallas(boxes, scores, max_out=2000,
         r = f()
     _ = np.asarray(jax.device_get(r[0]))[0]
     print(f"{name} 12000->2000: {(time.time() - t0) / 20 * 1000:.1f} ms")
+
+# timing: fused assign kernel vs dense XLA reductions near FPN scale
+# (P2 dominates FPN's 155 520 concatenated anchors; G = 100 like COCO)
+anchors_t = jnp.asarray(all_anchors(152, 256, 4,
+                                    generate_anchors(scales=(8,))))
+rng = np.random.RandomState(0)
+gt_t = np.zeros((100, 4), np.float32)
+for i in range(60):
+    x1, y1 = rng.rand(2) * np.array([800, 400])
+    gt_t[i] = [x1, y1, x1 + 20 + rng.rand() * 160, y1 + 20 + rng.rand() * 160]
+gt_t = jnp.asarray(gt_t)
+valid_t = jnp.asarray(np.arange(100) < 60)
+inside_t = jnp.asarray(np.random.RandomState(1).rand(
+    anchors_t.shape[0]) > 0.3)
+
+
+@jax.jit
+def dense_reduce(anchors, gt, gv, ins):
+    ov = bbox_overlaps(anchors, gt)
+    ov = jnp.where(gv[None, :], ov, -1.0)
+    ov_in = jnp.where(ins[:, None], ov, -1.0)
+    gm = jnp.max(ov_in, axis=0)
+    return (jnp.max(ov, axis=1), jnp.argmax(ov, axis=1), gm,
+            jnp.any((ov_in == gm[None, :]) & gv[None, :]
+                    & (gm[None, :] > 0), axis=1))
+
+
+for name, f in (("assign fused", lambda: assign_reduce_pallas(
+                    anchors_t, gt_t, valid_t, inside_t)),
+                ("assign dense", lambda: dense_reduce(
+                    anchors_t, gt_t, valid_t, inside_t))):
+    r = f()
+    jax.block_until_ready(r)
+    t0 = time.time()
+    for _ in range(50):
+        r = f()
+    _ = np.asarray(jax.device_get(r[0]))[0]
+    print(f"{name} @116736x100: {(time.time() - t0) / 50 * 1000:.2f} ms")
 
 raise SystemExit(1 if fails else 0)

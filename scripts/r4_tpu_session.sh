@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Round-4 TPU measurement session — run serially the moment the tunnel is
+# healthy (NEVER overlap TPU jobs; see .claude/skills/verify gotchas).
+# Usage: bash scripts/r4_tpu_session.sh [logfile]
+# Each step prints its own JSON/ledger lines; the log is the round-4
+# evidence for: tunnel gauge, loader-inclusive window (owed 2 rounds),
+# FPN bf16-IoU lever ms, VGG16 ledger, mask-eval recheck.
+set -x
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/r4_tpu_session.log}
+{
+  echo "=== $(date -u) gauge: staged headline bench"
+  python bench.py
+
+  echo "=== $(date -u) loader-inclusive attempt 1"
+  python bench.py --mode loader
+  echo "=== $(date -u) loader-inclusive attempt 2"
+  python bench.py --mode loader
+
+  echo "=== $(date -u) FPN base"
+  python bench.py --network resnet101_fpn
+  echo "=== $(date -u) FPN bf16-IoU lever"
+  python bench.py --network resnet101_fpn --cfg TRAIN__RPN_ASSIGN_IOU_BF16=True
+
+  echo "=== $(date -u) VGG16 train bench"
+  python bench.py --network vgg16
+  echo "=== $(date -u) VGG16 infer bench"
+  python bench.py --mode infer --network vgg16
+
+  echo "=== $(date -u) mask eval bench"
+  python bench.py --mode infer-mask
+} 2>&1 | tee "$LOG"

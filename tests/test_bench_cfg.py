@@ -1,0 +1,40 @@
+"""bench.py --cfg plumbing: overrides must reach the generated config
+(the round-4 lever A/B rides on this) without touching any device."""
+
+import sys
+
+
+def test_bench_cfg_overrides_reach_config():
+    import bench
+
+    bench.CFG_OVERRIDES["TRAIN__RPN_ASSIGN_IOU_BF16"] = True
+    try:
+        cfg = bench.make_cfg("resnet101_fpn")
+        assert cfg.TRAIN.RPN_ASSIGN_IOU_BF16 is True
+        assert cfg.network.HAS_FPN
+    finally:
+        bench.CFG_OVERRIDES.clear()
+    assert bench.make_cfg("resnet101_fpn").TRAIN.RPN_ASSIGN_IOU_BF16 is False
+
+
+def test_bench_cfg_cli_parse_and_metric_suffix(monkeypatch, capsys):
+    """--cfg flows through the shared parser and marks the metric _ab so an
+    overridden run can never be mistaken for a headline number."""
+    import bench
+
+    monkeypatch.setattr(
+        sys, "argv",
+        ["bench.py", "--mode", "train", "--cfg",
+         "TRAIN__RPN_ASSIGN_IOU_BF16=True"])
+    monkeypatch.setattr(bench, "bench_train_staged",
+                        lambda batch, network: 42.0)
+    try:
+        bench.main()
+    finally:
+        bench.CFG_OVERRIDES.clear()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    import json
+
+    rec = json.loads(out)
+    assert rec["metric"].endswith("_ab")
+    assert rec["vs_baseline"] is None  # override runs never set the ratio

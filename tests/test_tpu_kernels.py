@@ -28,10 +28,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def test_pallas_nms_matches_oracle_on_chip():
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    probe = subprocess.run(
-        [sys.executable, "-c",
-         "import jax; print(jax.default_backend())"],
-        env=env, capture_output=True, text=True, timeout=120, cwd=REPO)
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            env=env, capture_output=True, text=True, timeout=120, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        # dead tunnel: the axon sitecustomize blocks interpreter start
+        # retrying the backend (verify-skill gotcha) — that is "no TPU",
+        # not a kernel regression
+        pytest.skip("no TPU attached (backend probe timed out — tunnel down)")
     if "tpu" not in probe.stdout:
         pytest.skip(f"no TPU attached (backend: {probe.stdout.strip() or probe.stderr[-200:]})")
 
