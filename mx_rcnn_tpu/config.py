@@ -206,10 +206,14 @@ class TPUConfig:
     COMPUTE_DTYPE: str = "bfloat16"
     # fused Pallas assign-IoU reductions (kernels/assign_pallas.py): the
     # (N, G) anchor-IoU matrix never materializes — IoU is recomputed per
-    # tile on the fly (bit-identical f32 semantics; ~100x less HBM traffic
-    # at FPN's 155k anchors).  Escape hatch: False = dense XLA path.
-    # Auto-falls-back off-TPU and when MAX_GT > 128.
-    ASSIGN_FUSED: bool = True
+    # tile on the fly (ULP-level f32 parity; ~100x less HBM traffic at
+    # FPN's 155k anchors).  Auto-falls-back off-TPU and when MAX_GT > 128.
+    # STAGED DEFAULT: False until the kernel has lowered + passed
+    # check_pallas.py on a real chip (the round-4 TPU tunnel was down for
+    # the kernel's entire development window; an unvalidated Mosaic kernel
+    # must not sit on the default train path).  Flip to True the moment
+    # the on-chip gate is green — scripts/r4_tpu_session.sh runs it first.
+    ASSIGN_FUSED: bool = False
     # ROIAlign samples per bin axis.  Classic configs default to 1: still
     # at-or-above the reference's integer-binned ROIPooling fidelity and
     # 1.8x faster end-to-end (4x fewer gather points).  FPN/Mask presets

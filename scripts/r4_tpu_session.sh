@@ -17,10 +17,16 @@ LOG=${1:-/tmp/r4_tpu_session.log}
   echo "=== $(date -u) loader-inclusive attempt 2"
   python bench.py --mode loader
 
-  echo "=== $(date -u) FPN base"
+  echo "=== $(date -u) Pallas gate + assign-kernel timing"
+  python scripts/check_pallas.py
+
+  echo "=== $(date -u) FPN with fused assign kernel (the new default)"
   python bench.py --network resnet101_fpn
-  echo "=== $(date -u) FPN bf16-IoU lever"
-  python bench.py --network resnet101_fpn --cfg TRAIN__RPN_ASSIGN_IOU_BF16=True
+  echo "=== $(date -u) FPN dense assign (round-3 baseline path)"
+  python bench.py --network resnet101_fpn --cfg tpu__ASSIGN_FUSED=False
+  echo "=== $(date -u) FPN dense + bf16-IoU lever"
+  python bench.py --network resnet101_fpn --cfg tpu__ASSIGN_FUSED=False \
+      --cfg TRAIN__RPN_ASSIGN_IOU_BF16=True
 
   echo "=== $(date -u) VGG16 train bench"
   python bench.py --network vgg16
