@@ -32,7 +32,12 @@ LOG=${1:-/tmp/r4_tpu_session.log}
   python bench.py --network vgg16
   echo "=== $(date -u) VGG16 infer bench"
   python bench.py --mode infer --network vgg16
+  echo "=== $(date -u) VGG16 step profile (ledger attribution)"
+  python scripts/profile_step.py --network vgg16
 
   echo "=== $(date -u) mask eval bench"
   python bench.py --mode infer-mask
+
+  echo "=== $(date -u) loader overlap trace (fallback evidence)"
+  python scripts/trace_loader.py
 } 2>&1 | tee "$LOG"
