@@ -208,11 +208,17 @@ class TPUConfig:
     # (N, G) anchor-IoU matrix never materializes — IoU is recomputed per
     # tile on the fly (ULP-level f32 parity; ~100x less HBM traffic at
     # FPN's 155k anchors).  Auto-falls-back off-TPU and when MAX_GT > 128.
-    # STAGED DEFAULT: False until the kernel has lowered + passed
-    # check_pallas.py on a real chip (the round-4 TPU tunnel was down for
-    # the kernel's entire development window; an unvalidated Mosaic kernel
-    # must not sit on the default train path).  Flip to True the moment
-    # the on-chip gate is green — scripts/r4_tpu_session.sh runs it first.
+    # MEASURED AND REJECTED as the default (round 4, on-chip).  The gate
+    # is green (check_pallas.py equivalence OK on TPU v5 lite) but the
+    # kernel LOSES on device time: xplane-profiled FPN step 23.15 ms
+    # fused vs 21.95 ms dense (r4_tpu_session3.log), matching the chained
+    # standalone microbench (4.69 vs 2.75 ms @116736x100).  Wall-clock
+    # train A/Bs that showed fused ahead (41.07 vs 38.33 imgs/s) did not
+    # survive an interleaved repeat (39.15 vs 39.07) — tunnel-dispatch
+    # weather, which is why device profile is the deciding instrument.
+    # The recompute-per-tile traffic saving is real but the recompute
+    # cost exceeds it at G=100; stays available as an opt-in and as a
+    # libtpu-upgrade retry candidate.
     ASSIGN_FUSED: bool = False
     # ROIAlign samples per bin axis.  Classic configs default to 1: still
     # at-or-above the reference's integer-binned ROIPooling fidelity and

@@ -271,6 +271,11 @@ class VGGConv(nn.Module):
                             name=f"conv{b}_{i}")(x)
                 x = nn.relu(x)
             if b < 5:
+                # reduce_window form kept: the reshape+max alternative
+                # (ops/pool.py) measured device-neutral on-chip — XLA's
+                # select-and-scatter bwd costs the same as the equality-
+                # select bwd here (17.34 vs 17.33 ms step; BASELINE.md
+                # round-4 ledger) — so reference-exact tie routing wins.
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
         return x
 

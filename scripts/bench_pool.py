@@ -1,0 +1,64 @@
+"""Microbench: 2x2/2 max-pool backward — reduce_window (select-and-scatter
+bwd) vs non-overlapping reshape+max (equality-select bwd).
+
+Motivation (round-4 VGG16 xplane, r4_tpu_session.log): the two live
+select-and-scatter ops (pool3/pool4 bwd; pool1/2 are DCE'd behind the
+frozen conv1-2) cost ~1.4 ms of the 17.33 ms step.  For stride-2 2x2
+windows the pools are non-overlapping, so the general overlapping-window
+machinery (and its scatter-based transpose) is pure overhead.
+
+Reference: MXNet Pooling op (cudnn max-pool bwd routes gradient to the
+window argmax); the reshape form splits ties evenly — bwd-only
+divergence, ledgered in BASELINE.md.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mx_rcnn_tpu.ops.pool import max_pool_2x2
+
+SHAPES = [  # the two live VGG16 bwd pools at 608x1024 input
+    (1, 152, 256, 256),
+    (1, 76, 128, 512),
+]
+
+
+def timed(f, x, n=20):
+    r = f(x)
+    jax.block_until_ready(r)
+    t0 = time.time()
+    for _ in range(n):
+        r = f(x)
+    jax.block_until_ready(r)
+    return (time.time() - t0) / n * 1000
+
+
+def main():
+    for shape in SHAPES:
+        x = jnp.ones(shape, jnp.bfloat16) * 0.5 + \
+            jax.random.normal(jax.random.PRNGKey(0), shape, jnp.bfloat16)
+
+        def loss_rw(x):
+            return nn.max_pool(x, (2, 2), strides=(2, 2)).astype(jnp.float32).sum()
+
+        def loss_rs(x):
+            return max_pool_2x2(x).astype(jnp.float32).sum()
+
+        g_rw = jax.jit(jax.grad(loss_rw))
+        g_rs = jax.jit(jax.grad(loss_rs))
+        fwd_equal = bool(jnp.array_equal(
+            nn.max_pool(x, (2, 2), strides=(2, 2)), max_pool_2x2(x)))
+        print(f"{shape}: fwd_equal={fwd_equal} "
+              f"reduce_window_bwd={timed(g_rw, x):.3f} ms "
+              f"reshape_bwd={timed(g_rs, x):.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

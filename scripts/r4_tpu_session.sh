@@ -20,8 +20,11 @@ LOG=${1:-/tmp/r4_tpu_session.log}
   echo "=== $(date -u) Pallas gate + assign-kernel timing"
   python scripts/check_pallas.py
 
+  # NOTE: at original run time ASSIGN_FUSED temporarily defaulted True;
+  # it was later measured-and-rejected (config.py) so the flag is now
+  # explicit to keep this leg meaning what its label says on a rerun.
   echo "=== $(date -u) FPN with fused assign kernel (the new default)"
-  python bench.py --network resnet101_fpn
+  python bench.py --network resnet101_fpn --cfg tpu__ASSIGN_FUSED=True
   echo "=== $(date -u) FPN dense assign (round-3 baseline path)"
   python bench.py --network resnet101_fpn --cfg tpu__ASSIGN_FUSED=False
   echo "=== $(date -u) FPN dense + bf16-IoU lever"

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Round-4 TPU follow-up batch (serial; run only when no other TPU job):
+#   1. max-pool bwd microbench (select-and-scatter vs reshape+max)
+#   2. VGG16 train bench + step profile.  NOTE: the original round-4 run
+#      (r4_tpu_session2.log, headers "(reshape pool)") executed with the
+#      reshape+max pool temporarily wired into VGGConv; it measured
+#      device-NEUTRAL and was reverted (ops/pool.py records the result).
+#      To retry the lever on a libtpu upgrade, point VGGConv's 2x2 pool
+#      at ops/pool.max_pool_2x2 again — as committed these legs bench
+#      the default nn.max_pool path.
+#   3. FPN fused-assign interleaved repeat A/B.  Flags are explicit
+#      (the original run relied on a since-reverted default flip);
+#      wall A/Bs here flip-flopped with tunnel weather — device profile
+#      (scripts/profile_step.py, r4_tpu_session3.log) was the deciding
+#      instrument: dense 21.95 vs fused 23.15 ms.
+set -x
+cd "$(dirname "$0")/.."
+LOG=${1:-/root/repo/r4_tpu_session2.log}
+{
+  echo "=== $(date -u) max-pool bwd microbench"
+  python scripts/bench_pool.py
+
+  echo "=== $(date -u) VGG16 train bench"
+  python bench.py --network vgg16
+  echo "=== $(date -u) VGG16 step profile"
+  python scripts/profile_step.py --network vgg16
+
+  echo "=== $(date -u) FPN A/B interleaved: fused 1"
+  python bench.py --network resnet101_fpn --cfg tpu__ASSIGN_FUSED=True
+  echo "=== $(date -u) FPN A/B interleaved: dense 1"
+  python bench.py --network resnet101_fpn --cfg tpu__ASSIGN_FUSED=False
+  echo "=== $(date -u) FPN A/B interleaved: fused 2"
+  python bench.py --network resnet101_fpn --cfg tpu__ASSIGN_FUSED=True
+  echo "=== $(date -u) FPN A/B interleaved: dense 2"
+  python bench.py --network resnet101_fpn --cfg tpu__ASSIGN_FUSED=False
+} 2>&1 | tee "$LOG"

@@ -73,3 +73,32 @@ def test_bbox_overlaps(rng):
     got = B.bbox_overlaps(jnp.asarray(boxes), jnp.asarray(query))
     want = oracles.iou_oracle(boxes, query)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_max_pool_2x2_matches_reduce_window(rng):
+    from flax import linen as nn
+
+    from mx_rcnn_tpu.ops.pool import max_pool_2x2
+
+    for shape in [(1, 8, 12, 3), (2, 7, 9, 4), (1, 1, 1, 2)]:
+        x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+        want = nn.max_pool(x, (2, 2), strides=(2, 2))
+        got = max_pool_2x2(x)
+        assert got.shape == want.shape, (shape, got.shape, want.shape)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_max_pool_2x2_grad_ties_split():
+    """Documented divergence (ops/pool.py): tie gradients split evenly
+    (reduce_window's select-and-scatter routes all to the first max)."""
+    import jax
+
+    from mx_rcnn_tpu.ops.pool import max_pool_2x2
+
+    x = jnp.full((1, 2, 2, 1), 3.0)  # one window, all four tied
+    g = jax.grad(lambda v: max_pool_2x2(v).sum())(x)
+    np.testing.assert_allclose(np.asarray(g).ravel(), [0.25] * 4)
+    # no ties: gradient lands on the unique argmax
+    x2 = jnp.asarray(np.arange(4, dtype=np.float32).reshape(1, 2, 2, 1))
+    g2 = jax.grad(lambda v: max_pool_2x2(v).sum())(x2)
+    np.testing.assert_allclose(np.asarray(g2).ravel(), [0, 0, 0, 1])
