@@ -227,11 +227,14 @@ class TPUConfig:
     # NOTE: affects numerics; train and eval must use the same value (any
     # consistent generate_config call does).
     ROI_SAMPLING_RATIO: int = 1
-    # RoI pooling reduction over the sampled grid: "avg" (ROIAlign paper /
-    # torchvision) or "max" (closer to the reference's CUDA ROIPooling max
-    # reduction — see ops/roi_align.py:roi_pool).  Identical at
-    # ROI_SAMPLING_RATIO=1 where the grid has one sample per bin; the A/B
-    # ledger in BASELINE.md measures the delta at 2.
+    # RoI pooling reduction: "avg" (ROIAlign paper / torchvision), "max"
+    # (max over the same continuous sample grid), or "exact" — the
+    # reference's integer-binned CUDA ROIPooling semantics
+    # (rounded corners, overlapping integer bins, plain max, empty-bin
+    # zeros; ops/roi_align.py:_roi_pool_exact).  "exact" is the transplant
+    # mode: inference on MXNet-trained weights reproduces the op those
+    # weights saw.  "avg"/"max" are identical at ROI_SAMPLING_RATIO=1;
+    # the A/B ledger in BASELINE.md measures the deltas.
     ROI_MODE: str = "avg"
     # host→device prefetch depth
     PREFETCH: int = 2

@@ -8,6 +8,16 @@ The data layer rasterizes each gt polygon ONCE into a fixed-resolution crop
 aligned to the gt box (``gt_masks``: (G, S, S), gt-box frame).  In-graph we
 map each RoI's 28×28 grid into that gt-box frame and bilinearly sample —
 fully static shapes, no polygon math on device.
+
+Round 4: the sampler is SEPARABLE (the RoIAlign lesson, ops/roi_align.py)
+— the bilinear resample of RoI r is ``Wy[r] @ mask[r] @ Wx[r]^T`` with
+(out, S) one-axis interpolation matrices, two einsums on the MXU instead
+of 4 gathers per output pixel.  The round-4 mask-config profile
+attributed 4.1 ms/step to this op's gather form (``fusion f32[100352]``,
+r4_tpu_session4.log) — TPU gathers serialize (the round-3 loss lesson);
+the einsum form is ~112 MFLOP ≈ noise.  The gather path stays as the
+vmapped oracle (`_sample_gather`), parity-tested in
+tests/test_fpn_mask.py.
 """
 
 from __future__ import annotations
@@ -49,6 +59,36 @@ def mask_targets_for_rois(gt_masks: jnp.ndarray, gt_boxes: jnp.ndarray,
 
     masks = gt_masks[gt_index].astype(jnp.float32)    # (R, S, S)
 
+    # separable form: target[r] = Wy[r] @ mask[r] @ Wx[r]^T on the MXU
+    wy = _lerp_weights(my, s)                         # (R, out, S)
+    wx = _lerp_weights(mx, s)
+    u = jnp.einsum("rpy,ryx->rpx", wy, masks)
+    out = jnp.einsum("rqx,rpx->rpq", wx, u)           # (R, out, out)
+    return (out >= 0.5).astype(jnp.float32)
+
+
+def _lerp_weights(t: jnp.ndarray, s: int) -> jnp.ndarray:
+    """One-axis linear-interpolation matrix (..., out, S) for coords ``t``.
+
+    Row p carries `_sample_gather`'s edge semantics exactly: weight
+    (1-frac) on clip(floor(t)) and frac on clip(floor(t)+1) — at the top
+    edge both clip to S-1 and the weights sum to 1 — and rows for
+    outside points (t ≤ -1 or t ≥ S) are all-zero.
+    """
+    cells = jnp.arange(s, dtype=jnp.float32)
+    inside = (t > -1.0) & (t < s)
+    t0 = jnp.clip(jnp.floor(t), 0, s - 1)
+    t1 = jnp.clip(t0 + 1, 0, s - 1)
+    frac = jnp.clip(t - t0, 0.0, 1.0)
+    w = ((1.0 - frac)[..., None] * (cells == t0[..., None]) +
+         frac[..., None] * (cells == t1[..., None]))
+    return jnp.where(inside[..., None], w, 0.0)
+
+
+def _sample_gather(masks, my, mx, out_size: int, s: int):
+    """The original per-pixel 4-gather sampler — kept as the separable
+    path's oracle (TPU gathers serialize; 4.1 ms/step at (128, 28, 28) in
+    the round-4 profile vs ~noise for the einsum form)."""
     def sample_one(m, yy, xx):
         yy2 = jnp.broadcast_to(yy[:, None], (out_size, out_size))
         xx2 = jnp.broadcast_to(xx[None, :], (out_size, out_size))
@@ -64,5 +104,4 @@ def mask_targets_for_rois(gt_masks: jnp.ndarray, gt_boxes: jnp.ndarray,
              + ly * (1 - lx) * m[y1i, x0i] + ly * lx * m[y1i, x1i])
         return jnp.where(inside, v, 0.0)
 
-    out = jax.vmap(sample_one)(masks, my, mx)         # (R, out, out)
-    return (out >= 0.5).astype(jnp.float32)
+    return jax.vmap(sample_one)(masks, my, mx)

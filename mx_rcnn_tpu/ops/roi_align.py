@@ -167,6 +167,90 @@ def _roi_align_gather(features, rois, spatial_scale, pooled, sampling, mode):
     return jax.vmap(one)(rois)
 
 
+def _exact_axis_mask(start, size, n: int, pooled: int):
+    """Integer bin membership for one axis of one RoI → bool (pooled, n).
+
+    Reference bin arithmetic (MXNet ``roi_pooling.cu``): bin p covers
+    feature cells [floor(p·size/P), ceil((p+1)·size/P)) offset by
+    ``start``, clipped to [0, n); an empty range yields an all-False row.
+    """
+    # EXACT integer bin arithmetic: floor(p·size/P) = p·size // P and
+    # ceil((p+1)·size/P) = -((-(p+1)·size) // P) — no float division.
+    # Fidelity note: the CUDA kernel computes these with f32
+    # `(float)size / P` then `floor/ceil(p * bin_size)`.  For every
+    # non-integer p·size/P the f32 result provably equals the exact one
+    # (the quotient sits ≥ 1/P away from an integer, f32 error ~1e-5 at
+    # these magnitudes); at exact-integer boundaries f32 rounding can
+    # leak ONE extra already-clipped cell into the last bin
+    # (ceil(P·RN(size/P)) = size+1 for some sizes).  That quirk is
+    # hardware-arithmetic noise, not design intent, and is NOT
+    # reproduced: XLA's accelerator divide is reciprocal-based (≠ IEEE
+    # RTN), so matching it bit-for-bit in-graph is not portably
+    # possible.  Everything else — rounding, inclusive widths,
+    # overlapping bins, empty-bin zeros — is exact.
+    p = jnp.arange(pooled, dtype=jnp.int32)
+    lo = (p * size) // pooled + start
+    hi = -((-(p + 1) * size) // pooled) + start
+    lo = jnp.clip(lo, 0, n)
+    hi = jnp.clip(hi, 0, n)
+    cells = jnp.arange(n, dtype=jnp.int32)
+    return (cells[None, :] >= lo[:, None]) & (cells[None, :] < hi[:, None])
+
+
+def _roi_pool_exact(features, rois, spatial_scale, pooled):
+    """The reference's integer-binned max ROIPooling, semantics-exact.
+
+    Semantics of MXNet's CUDA ``ROIPoolForwardKernel`` (roi_pooling.cu),
+    the op the classic configs actually trained with:
+      * RoI corners ROUNDED to integer feature cells
+        (round(coord × spatial_scale)), inclusive, min size 1 cell;
+      * bin p spans integer cells [floor(p·sz/P), ceil((p+1)·sz/P)) —
+        bins OVERLAP when the RoI is small and skip cells when large,
+        unlike ROIAlign's uniform continuous bins;
+      * plain max over the bin's cells, no interpolation;
+      * empty bins (fully clipped) output 0.
+
+    TPU formulation: the bin membership is separable (rows × cols), so
+    the pool is two masked max-reductions — cols then rows — with static
+    shapes; XLA fuses the where-mask into each reduction so the
+    (R, P, H, W, C) predicate product never materializes (the
+    intermediate is (R, P, H, C)).  Backward: JAX's reduce-max VJP
+    splits tie gradients evenly where the CUDA kernel's atomic add goes
+    to the recorded argmax — irrelevant for the intended use (inference
+    on byte-exact MXNet weight transplants; see divergence ledger).
+    """
+    h, w, _ = features.shape
+
+    def rnd(v):
+        # C roundf (what the CUDA kernel calls): half away from zero —
+        # jnp.round would be banker's (half to even)
+        return (jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)).astype(jnp.int32)
+
+    def masks(roi):
+        x1 = rnd(roi[0] * spatial_scale)
+        y1 = rnd(roi[1] * spatial_scale)
+        x2 = rnd(roi[2] * spatial_scale)
+        y2 = rnd(roi[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        return (_exact_axis_mask(y1, rh, h, pooled),
+                _exact_axis_mask(x1, rw, w, pooled))
+
+    my, mx = jax.vmap(masks)(rois)                 # (R, P, H), (R, P, W)
+    dt = (features.dtype if jnp.issubdtype(features.dtype, jnp.floating)
+          else jnp.float32)
+    neg = jnp.asarray(jnp.finfo(dt).min, dt)
+    f = features.astype(dt)
+    # cols: t[r, q, h, c] = max over the w-cells of bin column q
+    t = jnp.max(jnp.where(mx[:, :, None, :, None],
+                          f[None, None, :, :, :], neg), axis=3)
+    # rows: out[r, p, q, c] = max over the h-cells of bin row p
+    out = jnp.max(jnp.where(my[:, :, None, :, None],
+                            t[:, None, :, :, :], neg), axis=3)
+    valid = (my.any(axis=2)[:, :, None] & mx.any(axis=2)[:, None, :])
+    return jnp.where(valid[..., None], out, jnp.zeros((), dt))
+
+
 @partial(jax.jit, static_argnames=("pooled_size", "sampling_ratio", "spatial_scale", "mode"))
 def roi_align(
     features: jnp.ndarray,
@@ -185,8 +269,13 @@ def roi_align(
 
     Returns: (R, pooled, pooled, C).
     """
-    if mode not in ("avg", "max"):
-        raise ValueError(f"roi_align mode must be 'avg' or 'max', got {mode!r}")
+    if mode not in ("avg", "max", "exact"):
+        raise ValueError(
+            f"roi_align mode must be 'avg', 'max' or 'exact', got {mode!r}")
+    if mode == "exact":
+        # the reference's integer-binned ROIPooling semantics
+        # (sampling_ratio is meaningless there and ignored)
+        return _roi_pool_exact(features, rois, spatial_scale, pooled_size)
     if mode == "avg" or sampling_ratio == 1:
         # max == avg at one sample per bin, so the separable path covers it
         return _roi_align_separable(features, rois, spatial_scale,

@@ -23,7 +23,7 @@ LOG=${1:-/tmp/r4_tpu_session.log}
   # NOTE: at original run time ASSIGN_FUSED temporarily defaulted True;
   # it was later measured-and-rejected (config.py) so the flag is now
   # explicit to keep this leg meaning what its label says on a rerun.
-  echo "=== $(date -u) FPN with fused assign kernel (the new default)"
+  echo "=== $(date -u) FPN with fused assign kernel (opt-in)"
   python bench.py --network resnet101_fpn --cfg tpu__ASSIGN_FUSED=True
   echo "=== $(date -u) FPN dense assign (round-3 baseline path)"
   python bench.py --network resnet101_fpn --cfg tpu__ASSIGN_FUSED=False

@@ -168,3 +168,47 @@ def test_mask_targets_outside_gt_box():
     t = mask_targets_for_rois(jnp.asarray(gm), gt_boxes, rois,
                               jnp.asarray([0]), out_size=28)
     assert np.asarray(t).sum() == 0
+
+
+def test_mask_targets_separable_matches_gather_oracle():
+    """The round-4 einsum form must reproduce the original per-pixel
+    4-gather sampler (kept as `_sample_gather`) — float values to ulp
+    noise and thresholded binaries exactly (random data puts nothing at
+    the 0.5 boundary)."""
+    from mx_rcnn_tpu.ops.mask_target import _lerp_weights, _sample_gather
+
+    rng = np.random.RandomState(7)
+    G, S, R, OUT = 5, 112, 24, 28
+    gm = (rng.rand(G, S, S) > 0.4).astype(np.float32)
+    gtb = np.stack([rng.uniform(0, 80, G), rng.uniform(0, 60, G),
+                    rng.uniform(90, 180, G), rng.uniform(70, 120, G)],
+                   axis=1).astype(np.float32)
+    rois = np.stack([rng.uniform(-20, 100, R), rng.uniform(-20, 80, R),
+                     rng.uniform(110, 220, R), rng.uniform(90, 160, R)],
+                    axis=1).astype(np.float32)
+    gi = rng.randint(0, G, R)
+
+    # re-derive the shared grid exactly as mask_targets_for_rois does
+    box = gtb[gi]
+    bw = np.maximum(box[:, 2] - box[:, 0], 1e-3)
+    bh = np.maximum(box[:, 3] - box[:, 1], 1e-3)
+    ys = (np.arange(OUT, dtype=np.float32) + 0.5) / OUT
+    gy = rois[:, 1:2] + ys[None, :] * (rois[:, 3:4] - rois[:, 1:2])
+    gx = rois[:, 0:1] + ys[None, :] * (rois[:, 2:3] - rois[:, 0:1])
+    my = (gy - box[:, 1:2]) / bh[:, None] * S - 0.5
+    mx = (gx - box[:, 0:1]) / bw[:, None] * S - 0.5
+    masks = jnp.asarray(gm[gi])
+
+    want = np.asarray(_sample_gather(masks, jnp.asarray(my), jnp.asarray(mx),
+                                     OUT, S))
+    wy = _lerp_weights(jnp.asarray(my), S)
+    wx = _lerp_weights(jnp.asarray(mx), S)
+    got = np.asarray(jnp.einsum("rqx,rpx->rpq", wx,
+                                jnp.einsum("rpy,ryx->rpx", wy, masks)))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    np.testing.assert_array_equal(got >= 0.5, want >= 0.5)
+
+    full = np.asarray(mask_targets_for_rois(
+        jnp.asarray(gm), jnp.asarray(gtb), jnp.asarray(rois),
+        jnp.asarray(gi), out_size=OUT))
+    np.testing.assert_array_equal(full, (want >= 0.5).astype(np.float32))
