@@ -23,6 +23,11 @@ from parse_xplane import main as print_xplane
 
 ap = argparse.ArgumentParser()
 ap.add_argument("--network", default="resnet101")
+ap.add_argument("--mode", default="train", choices=("train", "infer"),
+                help="train = jitted train step; infer = Predictor.predict "
+                     "(the test.py eval graph — round-4 addition after the "
+                     "mask-target profile surprise showed eval graphs were "
+                     "never device-profiled)")
 ap.add_argument("--batch", type=int, default=1)
 ap.add_argument("--repeat", type=int, default=10)
 ap.add_argument("--topn", type=int, default=40)
@@ -34,21 +39,35 @@ from mx_rcnn_tpu.tools.common import parse_cfg_overrides
 
 bench.CFG_OVERRIDES.update(parse_cfg_overrides(args.cfg))
 
-state, step, batch, _ = bench.build(args.batch, args.network)
-batch = jax.device_put(batch)
-key = jax.random.PRNGKey(7)
+if args.mode == "train":
+    state, step, batch, _ = bench.build(args.batch, args.network)
+    batch = jax.device_put(batch)
+    key = jax.random.PRNGKey(7)
+
+    def run():
+        global state
+        state, metrics = step(state, batch, key)
+        return metrics
+else:
+    pred, cfg = bench.build_infer(args.batch, args.network)
+    hbatch = bench.synthetic_batch(cfg, args.batch)
+    images = jax.device_put(hbatch["images"])
+    im_info = jax.device_put(hbatch["im_info"])
+
+    def run():
+        return pred.predict(images, im_info)
 
 for _ in range(3):
-    state, metrics = step(state, batch, key)
-jax.block_until_ready(metrics)
+    out = run()
+jax.block_until_ready(out)
 
 shutil.rmtree(args.dir, ignore_errors=True)
 with jax.profiler.trace(args.dir):
     for _ in range(args.repeat):
-        state, metrics = step(state, batch, key)
-    jax.block_until_ready(metrics)
+        out = run()
+    jax.block_until_ready(out)
 
 pb = glob.glob(f"{args.dir}/plugins/profile/*/*.xplane.pb")[0]
 print(f"(sums over {args.repeat} calls, network={args.network}, "
-      f"cfg={args.cfg})")
+      f"mode={args.mode}, cfg={args.cfg})")
 print_xplane(pb, topn=args.topn)

@@ -195,16 +195,20 @@ def test_roi_pool_exact_matches_reference_loop(rng):
 
 
 def test_roi_pool_exact_through_detector_cfg():
-    """ROI_MODE='exact' flows through generate_config and the full train
-    graph runs with it (the transplant escape hatch is usable end-to-end,
-    not just as a bare op)."""
+    """ROI_MODE='exact' flows through the generate_config override path
+    (the CLI's --cfg syntax) and the full train graph runs with it (the
+    transplant escape hatch is usable end-to-end, not just as a bare op)."""
+    import dataclasses
+
     from tests.test_detector import tiny_cfg, batch
     from mx_rcnn_tpu.models import build_model, init_params
+    from mx_rcnn_tpu.config import generate_config
 
+    # the string-override route the CLI uses
+    assert generate_config("resnet50", "PascalVOC",
+                           tpu__ROI_MODE="exact").tpu.ROI_MODE == "exact"
     cfg = tiny_cfg()
-    cfg = cfg.replace(tpu=__import__("dataclasses").replace(
-        cfg.tpu, ROI_MODE="exact"))
-    assert cfg.tpu.ROI_MODE == "exact"
+    cfg = cfg.replace(tpu=dataclasses.replace(cfg.tpu, ROI_MODE="exact"))
     model = build_model(cfg)
     imgs, im_info, gtb, gtc, gtv = batch()
     params = init_params(model, cfg, jax.random.PRNGKey(0), 2, (128, 192))
