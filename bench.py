@@ -10,9 +10,12 @@ COCO on disk), printed as exactly ONE JSON line:
 ``vs_baseline`` is the ratio against the recorded number in
 ``BENCH_BASELINE.json`` (the round-1 v5-lite measurement — BASELINE.md's
 "first measured baseline of our own"; the reference repo's 8×V100 table was
-unrecoverable, see SURVEY §0).  Timing uses chained steps with a single
-final sync: on tunneled devices per-step host reads dominate (≫ step time)
-and block_until_ready acks early, so only amortized chains measure truth.
+unrecoverable, see SURVEY §0).  Timing (round 4 onward) uses a ONE-dispatch
+``lax.fori_loop`` step chain at two lengths, differenced so the dispatch +
+readback fence cancels exactly (`bench_train_chain`) — the async-dispatch
+chain it replaces read 23.7–65.9 imgs/s across tunnel windows for a program
+whose device step was a stable 12.20 ms; `--legacy-dispatch` keeps the old
+method for comparison.
 
 Extra modes (manual, for BASELINE.md's scaling/honesty tables — each also
 prints one JSON line):
@@ -43,6 +46,17 @@ BASELINE_FILE = os.path.join(REPO, "BENCH_BASELINE.json")
 H, W = 608, 1024
 WARMUP = 5
 STEPS = 30
+# one-dispatch chain lengths (bench_train_chain); the difference n2-n1 is
+# what gets timed, the fixed dispatch+fence cost cancels in the subtraction.
+# SIZING MATTERS (first-version bug, r4_tpu_session7.log): with only 30
+# steps of difference (~0.4 s device) the tunnel's ±0.1 s+ dispatch-lag
+# variance dominated, and taking the BEST of 3 pairs selected favorable
+# noise — classic read 113 imgs/s against a 12.35 ms/step device truth
+# (chain program profiled by scripts/profile_chain.py; max-of-noisy-
+# differences is upward-biased).  160 steps of difference (~2 s device
+# classic) bounds the lag noise to a few percent, and the median kills
+# the selection bias.
+CHAIN_N1, CHAIN_N2 = 40, 200
 
 
 CFG_OVERRIDES: dict = {}  # set from --cfg (PATH=VALUE, common.py syntax)
@@ -56,8 +70,8 @@ def make_cfg(network: str = "resnet101"):
         cfg.network, PIXEL_STDS=(127.0, 127.0, 127.0)))
 
 
-def synthetic_batch(cfg, batch):
-    rng = np.random.RandomState(0)
+def synthetic_batch(cfg, batch, seed: int = 0):
+    rng = np.random.RandomState(seed)
     g = cfg.tpu.MAX_GT
     gtb = np.zeros((batch, g, 4), np.float32)
     gtv = np.zeros((batch, g), bool)
@@ -87,7 +101,7 @@ def synthetic_batch(cfg, batch):
     return out
 
 
-def build(batch: int = 1, network: str = "resnet101"):
+def build(batch: int = 1, network: str = "resnet101", donate: bool = True):
     from mx_rcnn_tpu.models import build_model, init_params
     from mx_rcnn_tpu.train import create_train_state, make_train_step
 
@@ -95,8 +109,85 @@ def build(batch: int = 1, network: str = "resnet101"):
     model = build_model(cfg)
     params = init_params(model, cfg, jax.random.PRNGKey(0), batch, (H, W))
     state, tx, mask = create_train_state(cfg, params, steps_per_epoch=1000)
-    step = make_train_step(model, tx, trainable_mask=mask)
+    step = make_train_step(model, tx, trainable_mask=mask, donate=donate)
     return state, step, synthetic_batch(cfg, batch), cfg
+
+
+def bench_train_chain(batch: int, network: str = "resnet101"):
+    """One-dispatch chained-step timing — the headline method since round 4.
+
+    The legacy method (``bench_train_staged``, kept behind
+    ``--legacy-dispatch``) dispatches N async step calls and syncs once.
+    On a locally-attached host that approaches device-bound throughput,
+    but through the axon tunnel each dispatch is an RPC, and in congested
+    windows the device starves BETWEEN steps: the same program read
+    23.7–65.9 imgs/s across round-3/4 windows while its xplane device
+    step was a stable 12.20 ms every time (BASELINE.md round-4 ledger).
+    A wall metric whose spread is 3x the quantity it measures is noise.
+
+    Here the whole chain is ONE program: ``lax.fori_loop`` over the train
+    step (same jitted step function, traced inline; fresh fold_in key per
+    iteration).  The staged batch is PERTURBED with key-derived noise
+    every iteration (sub-pixel gt jitter + epsilon image noise) so that
+    no data-dependent computation is loop-invariant.  This matters: a
+    constant batch let XLA hoist per-batch work — the FPN chain ran
+    3.9 ms/step faster than its own per-dispatch device profile because
+    the 155k-anchor assign-IoU (constant gt) moved out of the loop, and
+    even a 2-batch alternation left the gap (XLA computes both variants
+    once and indexes).  Real training recomputes that work per fresh
+    batch; the noise forces the loop to as well (r4_tpu_session7.log —
+    validated: per-step time in-loop == per-dispatch device profile).
+    Transfer overlap for real loaders is separately proven by the
+    round-4 loader trace.  Two chain lengths are timed and differenced,
+    so the single dispatch + readback fence cancels EXACTLY:
+
+        imgs/s = (n2 - n1) * batch / (t(n2) - t(n1))
+    """
+    from functools import partial
+
+    state, step, hbatch, _ = build(batch, network, donate=False)
+    dbatch = jax.device_put(hbatch)
+    key = jax.random.PRNGKey(0)
+
+    @partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
+    def chain(st, n):
+        def body(i, s):
+            k = jax.random.fold_in(key, i)
+            b = dict(dbatch)
+            # per-iteration perturbation: cheap (two fused elementwise
+            # broadcasts), but poisons every LICM opportunity downstream
+            b["images"] = dbatch["images"] + jax.random.uniform(
+                k, (), dtype=dbatch["images"].dtype, maxval=1e-3)
+            b["gt_boxes"] = dbatch["gt_boxes"] + jax.random.uniform(
+                jax.random.fold_in(k, 1), (), dtype=dbatch["gt_boxes"].dtype,
+                maxval=0.9)
+            return step(s, b, jax.random.fold_in(k, 2))[0]
+
+        return jax.lax.fori_loop(0, n, body, st)
+
+    n1, n2 = CHAIN_N1, CHAIN_N2
+    s0 = int(jax.device_get(state.step))
+    for n in (n1, n2):  # compile + warm both lengths
+        state = chain(state, n)
+    s1 = int(jax.device_get(state.step))  # full round-trip fence
+    assert s1 - s0 == n1 + n2, f"chain ran {s1 - s0} steps, not {n1 + n2}"
+
+    rates = []
+    for _ in range(3):
+        ts = {}
+        for n in (n1, n2):
+            t0 = time.time()
+            state = chain(state, n)
+            _ = int(jax.device_get(state.step))
+            ts[n] = time.time() - t0
+        if ts[n2] > ts[n1]:  # a window hiccup can invert the pair; skip it
+            rates.append((n2 - n1) * batch / (ts[n2] - ts[n1]))
+    if not rates:  # every pair inverted (pathological window): fall back
+        return bench_train_staged(batch, network)
+    # median for 3 valid pairs; LOWER-middle when pairs were skipped —
+    # with 2 samples the upper-middle is max-of-noise, the exact
+    # selection bias this rewrite exists to kill (see CHAIN_N note)
+    return sorted(rates)[(len(rates) - 1) // 2]
 
 
 def bench_train_staged(batch: int, network: str = "resnet101"):
@@ -263,6 +354,10 @@ def main():
                          "common.py syntax), e.g. "
                          "--cfg TRAIN__RPN_ASSIGN_IOU_BF16=True — for "
                          "A/B step-time measurements of ledger levers")
+    ap.add_argument("--legacy-dispatch", action="store_true",
+                    help="train mode: use the pre-round-4 async-dispatch "
+                         "chain (subject to tunnel dispatch-rate noise) "
+                         "instead of the one-dispatch fori_loop chain")
     args = ap.parse_args()
     from mx_rcnn_tpu.tools.common import parse_cfg_overrides
 
@@ -273,7 +368,8 @@ def main():
                         else "resnet101")
 
     if args.mode == "train":
-        value = bench_train_staged(args.batch, args.network)
+        fn = bench_train_staged if args.legacy_dispatch else bench_train_chain
+        value = fn(args.batch, args.network)
         metric = "train_imgs_per_sec_per_chip"
     elif args.mode == "loader":
         value = bench_train_loader(args.batch, args.network)

@@ -9,4 +9,5 @@ all-reduce performed by XLA-inserted collectives.
 """
 
 from mx_rcnn_tpu.parallel.mesh import (MeshPlan, check_spatial, make_mesh,
-                                        make_multislice_mesh, shard_batch)
+                                        make_multislice_mesh, shard_batch,
+                                        shard_stacked_batch)

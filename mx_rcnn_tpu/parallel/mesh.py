@@ -294,3 +294,29 @@ def shard_batch(plan: MeshPlan, batch):
             "spatial meshes require dict batches (the 'images' key selects "
             f"the height-sharded placement); got {type(batch).__name__}")
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+def stack_sharding(sh):
+    """The same placement with an unsharded leading (stack) axis
+    prepended — the one rule for multi-step (k, batch, ...) trees; both
+    ``shard_stacked_batch`` and ``make_multi_train_step``'s in_shardings
+    derive from here so the two can never diverge."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(sh.mesh, P(None, *sh.spec))
+
+
+def shard_stacked_batch(plan: MeshPlan, batches):
+    """Place a STACK of k host batches (every leaf (k, batch, ...)) onto
+    the mesh for ``make_multi_train_step``: the leading stack axis stays
+    unsharded, the batch axis splits over the data axes, and ``images``
+    additionally splits height over ``space`` when present."""
+    sh = stack_sharding(plan.batch())
+    if isinstance(batches, dict):
+        im_sh = stack_sharding(plan.images())
+        return jax.device_put(
+            batches, {k: im_sh if k == "images" else sh for k in batches})
+    if plan.n_space > 1:
+        raise TypeError(
+            "spatial meshes require dict batches (the 'images' key selects "
+            f"the height-sharded placement); got {type(batches).__name__}")
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batches)

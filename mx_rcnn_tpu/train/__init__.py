@@ -7,5 +7,7 @@ over a data mesh.
 from mx_rcnn_tpu.train.optim import make_optimizer, make_lr_schedule, fixed_param_mask
 from mx_rcnn_tpu.train.metric import MetricBank
 from mx_rcnn_tpu.train.callback import Speedometer
-from mx_rcnn_tpu.train.train_step import TrainState, make_train_step, create_train_state
+from mx_rcnn_tpu.train.train_step import (TrainState, create_train_state,
+                                          make_multi_train_step,
+                                          make_train_step)
 from mx_rcnn_tpu.train.trainer import fit

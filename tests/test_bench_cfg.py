@@ -26,6 +26,10 @@ def test_bench_cfg_cli_parse_and_metric_suffix(monkeypatch, capsys):
         sys, "argv",
         ["bench.py", "--mode", "train", "--cfg",
          "TRAIN__RPN_ASSIGN_IOU_BF16=True"])
+    # patch BOTH train methods: main() dispatches to the one-dispatch
+    # chain by default (round 4) and to staged under --legacy-dispatch
+    monkeypatch.setattr(bench, "bench_train_chain",
+                        lambda batch, network: 42.0)
     monkeypatch.setattr(bench, "bench_train_staged",
                         lambda batch, network: 42.0)
     try:

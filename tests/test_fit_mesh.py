@@ -67,3 +67,44 @@ def test_fit_loader_on_mesh():
     # both epochs' steps ran: 2 buckets × ceil(10/8 + 6/8) = 2 + 1 = 3
     # steps/epoch × 2 epochs
     assert int(jax.device_get(state.step)) == 6
+
+
+def test_multi_step_on_mesh_matches_single():
+    """make_multi_train_step over the 8-device DP mesh (stacked batch
+    shardings + shard_stacked_batch) at k=1: parity with the single-step
+    mesh program — the inductive contract; k>1 numeric parity is chaotic
+    (see test_train.test_multi_step_matches_sequential docstring).  The
+    k=2 real-loader path is covered structurally by
+    test_train.test_fit_steps_per_dispatch_smoke."""
+    from mx_rcnn_tpu.parallel import shard_batch, shard_stacked_batch
+    from mx_rcnn_tpu.train import (create_train_state, make_multi_train_step,
+                                   make_train_step)
+    from tests.test_train import make_batch
+
+    cfg = mesh_cfg()
+    plan = make_mesh(data=8)
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 8, (64, 96))
+    state0, tx, mask = create_train_state(cfg, params, steps_per_epoch=10)
+    state0 = jax.device_put(state0, plan.replicated())
+    batch = make_batch(8, seed=0)
+    key = jax.random.PRNGKey(7)
+
+    step = make_train_step(model, tx, plan=plan, trainable_mask=mask,
+                           donate=False)
+    seq, _ = step(state0, shard_batch(plan, batch),
+                  jax.random.fold_in(key, 0))
+
+    multi = make_multi_train_step(model, tx, 1, plan=plan,
+                                  trainable_mask=mask, donate=False)
+    stacked = shard_stacked_batch(
+        plan, jax.tree.map(lambda x: np.stack([x]), batch))
+    got, _ = multi(state0, stacked, key)
+
+    assert int(jax.device_get(got.step)) == 1
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a), np.float32),
+            np.asarray(jax.device_get(b), np.float32),
+            rtol=1e-4, atol=1e-5),
+        got.params, seq.params)

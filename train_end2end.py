@@ -33,6 +33,11 @@ def parse_args():
     add_common_args(parser, train=True)
     parser.add_argument("--profile", default="",
                         help="write an XProf device trace of early steps here")
+    parser.add_argument("--steps-per-dispatch", type=int, default=1,
+                        help="train steps per dispatched program (lax.scan "
+                             "grouping; >1 amortizes dispatch overhead and "
+                             "lets XLA compile the step as a loop body — "
+                             "see train/trainer.py fit docstring)")
     return parser.parse_args()
 
 
@@ -63,6 +68,7 @@ def train_net(args):
                 seed=getattr(args, "seed", 0),
                 frequent=args.frequent, resume=args.resume,
                 profile_dir=getattr(args, "profile", "") or None,
+                steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
                 fixed_prefixes=cfg.network.FIXED_PARAMS)
     return state
 
