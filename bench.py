@@ -70,8 +70,8 @@ def make_cfg(network: str = "resnet101"):
         cfg.network, PIXEL_STDS=(127.0, 127.0, 127.0)))
 
 
-def synthetic_batch(cfg, batch, seed: int = 0):
-    rng = np.random.RandomState(seed)
+def synthetic_batch(cfg, batch):
+    rng = np.random.RandomState(0)
     g = cfg.tpu.MAX_GT
     gtb = np.zeros((batch, g, 4), np.float32)
     gtv = np.zeros((batch, g), bool)
@@ -113,6 +113,36 @@ def build(batch: int = 1, network: str = "resnet101", donate: bool = True):
     return state, step, synthetic_batch(cfg, batch), cfg
 
 
+def make_chain_fn(step, dbatch, key=None):
+    """The ONE definition of the n-step fori_loop chain program (shared
+    by `bench_train_chain` and `scripts/profile_chain.py`, whose whole
+    purpose is to profile the program the bench times — a drifted copy
+    would silently validate a different program).  Per-iteration
+    key-derived batch perturbation (sub-pixel gt jitter + epsilon image
+    noise) poisons every LICM opportunity downstream; see
+    `bench_train_chain` for the measured story."""
+    from functools import partial
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    @partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
+    def chain(st, n):
+        def body(i, s):
+            k = jax.random.fold_in(key, i)
+            b = dict(dbatch)
+            b["images"] = dbatch["images"] + jax.random.uniform(
+                k, (), dtype=dbatch["images"].dtype, maxval=1e-3)
+            b["gt_boxes"] = dbatch["gt_boxes"] + jax.random.uniform(
+                jax.random.fold_in(k, 1), (), dtype=dbatch["gt_boxes"].dtype,
+                maxval=0.9)
+            return step(s, b, jax.random.fold_in(k, 2))[0]
+
+        return jax.lax.fori_loop(0, n, body, st)
+
+    return chain
+
+
 def bench_train_chain(batch: int, network: str = "resnet101"):
     """One-dispatch chained-step timing — the headline method since round 4.
 
@@ -143,27 +173,8 @@ def bench_train_chain(batch: int, network: str = "resnet101"):
 
         imgs/s = (n2 - n1) * batch / (t(n2) - t(n1))
     """
-    from functools import partial
-
     state, step, hbatch, _ = build(batch, network, donate=False)
-    dbatch = jax.device_put(hbatch)
-    key = jax.random.PRNGKey(0)
-
-    @partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
-    def chain(st, n):
-        def body(i, s):
-            k = jax.random.fold_in(key, i)
-            b = dict(dbatch)
-            # per-iteration perturbation: cheap (two fused elementwise
-            # broadcasts), but poisons every LICM opportunity downstream
-            b["images"] = dbatch["images"] + jax.random.uniform(
-                k, (), dtype=dbatch["images"].dtype, maxval=1e-3)
-            b["gt_boxes"] = dbatch["gt_boxes"] + jax.random.uniform(
-                jax.random.fold_in(k, 1), (), dtype=dbatch["gt_boxes"].dtype,
-                maxval=0.9)
-            return step(s, b, jax.random.fold_in(k, 2))[0]
-
-        return jax.lax.fori_loop(0, n, body, st)
+    chain = make_chain_fn(step, jax.device_put(hbatch))
 
     n1, n2 = CHAIN_N1, CHAIN_N2
     s0 = int(jax.device_get(state.step))

@@ -134,46 +134,50 @@ def make_train_step(model, tx: optax.GradientTransformation,
         check_spatial(plan, model.cfg)
 
     step = _build_step(model, tx, graph, trainable_mask)
-
     if plan is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return _jit_planned(step, plan, donate)
 
+
+def _jit_planned(fn, plan: MeshPlan, donate: bool, wrap=lambda sh: sh):
+    """jit ``fn(state, batch, key)`` with the plan's shardings — the one
+    wiring shared by the single-step and multi-step makers (``wrap``
+    lifts each batch sharding; the multi-step maker passes
+    ``stack_sharding`` to prepend the unsharded stack axis).
+
+    For tensor parallelism (MeshPlan.param_shardings on the head FCs)
+    and/or spatial parallelism (image height over the space axis), the
+    state sharding tree is structural and the batch sharding tree
+    depends on the batch's keys, so both are built lazily from the first
+    call and the jitted fn cached — keyed on the batch's key set: the
+    spatial in_shardings are a per-key dict, so a batch gaining/losing
+    an optional key (gt_masks) must get its own jitted entry, not a
+    pytree structure mismatch at dispatch."""
     repl = plan.replicated()
-    batch_sh = plan.batch()
+    batch_sh = wrap(plan.batch())
     if plan.n_model > 1 or plan.n_space > 1:
-        # (multi-step note: make_multi_train_step shares this lazy-cache
-        # pattern with a leading stack axis on every batch sharding)
-        # tensor parallelism (MeshPlan.param_shardings on the head FCs)
-        # and/or spatial parallelism (image height over the space axis):
-        # the state sharding tree is structural and the batch sharding
-        # tree depends on the batch's keys, so build both lazily from the
-        # first call and cache the jitted step
         cache = {}
 
         def stepper(state, batch, key):
-            # cache keyed on the batch's key set: the spatial in_shardings
-            # are a per-key dict, so a batch gaining/losing an optional
-            # key (gt_masks) must get its own jitted entry, not a pytree
-            # structure mismatch at dispatch
             ck = frozenset(batch) if plan.n_space > 1 else "fn"
-            fn = cache.get(ck)
-            if fn is None:
+            jitted = cache.get(ck)
+            if jitted is None:
                 st_sh = plan.state_shardings(state)
-                b_sh = ({k: plan.images() if k == "images" else batch_sh
+                b_sh = ({k: wrap(plan.images()) if k == "images" else batch_sh
                          for k in batch}
                         if plan.n_space > 1 else batch_sh)
-                fn = jax.jit(
-                    step,
+                jitted = jax.jit(
+                    fn,
                     in_shardings=(st_sh, b_sh, repl),
                     out_shardings=(st_sh, repl),
                     donate_argnums=(0,) if donate else (),
                 )
-                cache[ck] = fn
-            return fn(state, batch, key)
+                cache[ck] = jitted
+            return jitted(state, batch, key)
 
         return stepper
     return jax.jit(
-        step,
+        fn,
         in_shardings=(repl, batch_sh, repl),
         out_shardings=(repl, repl),
         donate_argnums=(0,) if donate else (),
@@ -221,34 +225,4 @@ def make_multi_train_step(model, tx: optax.GradientTransformation, k: int,
 
     if plan is None:
         return jax.jit(multi, donate_argnums=(0,) if donate else ())
-
-    repl = plan.replicated()
-    sbatch_sh = stack_sharding(plan.batch())
-    if plan.n_model > 1 or plan.n_space > 1:
-        cache = {}
-
-        def stepper(state, batches, key):
-            ck = frozenset(batches) if plan.n_space > 1 else "fn"
-            fn = cache.get(ck)
-            if fn is None:
-                st_sh = plan.state_shardings(state)
-                b_sh = ({kk: (stack_sharding(plan.images())
-                              if kk == "images" else sbatch_sh)
-                         for kk in batches}
-                        if plan.n_space > 1 else sbatch_sh)
-                fn = jax.jit(
-                    multi,
-                    in_shardings=(st_sh, b_sh, repl),
-                    out_shardings=(st_sh, repl),
-                    donate_argnums=(0,) if donate else (),
-                )
-                cache[ck] = fn
-            return fn(state, batches, key)
-
-        return stepper
-    return jax.jit(
-        multi,
-        in_shardings=(repl, sbatch_sh, repl),
-        out_shardings=(repl, repl),
-        donate_argnums=(0,) if donate else (),
-    )
+    return _jit_planned(multi, plan, donate, wrap=stack_sharding)

@@ -177,14 +177,6 @@ def fit(cfg: Config, model, params, train_loader,
                     state, metrics = multi_fn(state, stacked, sub)
                     pending = metrics
                     buf = []
-                elif i == steps_per_epoch - 1:
-                    for b in buf:
-                        key, sub = jax.random.split(key)
-                        if plan is not None:
-                            b = shard_batch(plan, b)
-                        state, metrics = step_fn(state, b, sub)
-                    pending = metrics
-                    buf = []
             # fetch metrics only at Speedometer cadence: a device→host scalar
             # read stalls the dispatch pipeline (and on tunneled devices costs
             # far more than a step), so per-step reads would serialize training
@@ -192,6 +184,16 @@ def fit(cfg: Config, model, params, train_loader,
                 bank.update(jax.device_get(pending))
                 pending = None
             speedo(epoch, i, bank.format())
+        if buf:  # epoch remainder (< k) — flushed AFTER the loop so the
+            # drain cannot depend on steps_per_epoch matching the
+            # iterator's true yield count (wrapper loaders may differ)
+            for b in buf:
+                key, sub = jax.random.split(key)
+                if plan is not None:
+                    b = shard_batch(plan, b)
+                state, metrics = step_fn(state, b, sub)
+            pending = metrics
+            buf = []
         if profiling:  # epoch shorter than the stop step: close the trace
             jax.block_until_ready(pending)
             jax.profiler.stop_trace()

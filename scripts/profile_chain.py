@@ -15,7 +15,6 @@ import glob
 import os
 import shutil
 import sys
-from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.join(
@@ -30,27 +29,10 @@ network = sys.argv[1] if len(sys.argv) > 1 else "resnet101"
 N = 40
 
 state, step, hbatch, cfg = bench.build(1, network, donate=False)
-# per-iteration key-derived batch perturbation, exactly like
-# bench_train_chain, so this profiles the same program the bench times
-# (a constant batch lets XLA hoist per-batch work out of the loop — the
-# bug this script caught; even a 2-batch alternation got hoisted)
-dbatch = jax.device_put(hbatch)
-key = jax.random.PRNGKey(0)
-
-
-@partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
-def chain(st, n):
-    def body(i, s):
-        k = jax.random.fold_in(key, i)
-        b = dict(dbatch)
-        b["images"] = dbatch["images"] + jax.random.uniform(
-            k, (), dtype=dbatch["images"].dtype, maxval=1e-3)
-        b["gt_boxes"] = dbatch["gt_boxes"] + jax.random.uniform(
-            jax.random.fold_in(k, 1), (), dtype=dbatch["gt_boxes"].dtype,
-            maxval=0.9)
-        return step(s, b, jax.random.fold_in(k, 2))[0]
-
-    return jax.lax.fori_loop(0, n, body, st)
+# bench.make_chain_fn is the ONE chain definition — this script profiles
+# the exact program bench_train_chain times (a copy here once drifted is
+# the bug class this script exists to catch)
+chain = bench.make_chain_fn(step, jax.device_put(hbatch))
 
 
 s0 = int(jax.device_get(state.step))
