@@ -146,15 +146,35 @@ class AnchorLoader:
     the GLOBAL images-per-step (the trainer shards over the mesh data axis).
     Incomplete trailing groups are wrapped by re-sampling from the group
     (reference pads the last batch by wrapping indices).
+
+    ``num_parts``/``part_index`` (the MXNet ``mx.io.DataIter`` partition
+    kwargs used with ``KVStore('dist_sync')``) make the loader multi-host:
+    the FULL epoch schedule — shuffle, aspect buckets, scale choice,
+    wrap-padding — is computed from the (replicated) roidb with the shared
+    seed, identical on every process, and each process then loads and
+    yields only rows ``[part_index·B/num_parts, (part_index+1)·B/num_parts)``
+    of every global batch.  Identical schedules are what keep all
+    processes dispatching the same compiled program in lockstep;
+    ``parallel.assert_loader_partition`` checks the slice matches the mesh
+    row shards this process owns.  ``batch_size`` and ``steps_per_epoch``
+    keep their GLOBAL meaning.
     """
 
     def __init__(self, roidb: list, cfg: Config, batch_size: int,
-                 shuffle: bool = True, seed: int = 0):
+                 shuffle: bool = True, seed: int = 0,
+                 num_parts: int = 1, part_index: int = 0):
         if not roidb:
             raise ValueError("empty roidb")
+        if not (0 <= part_index < num_parts):
+            raise ValueError(f"part_index {part_index} not in [0, {num_parts})")
+        if batch_size % num_parts:
+            raise ValueError(f"batch_size {batch_size} does not divide over "
+                             f"{num_parts} parts")
         self.roidb = roidb
         self.cfg = cfg
         self.batch_size = batch_size
+        self.num_parts = num_parts
+        self.part_index = part_index
         self.shuffle = shuffle
         # device double-buffering hook: when set (``fit`` installs the
         # plan-aware device_put), batches arrive on-device, transfer
@@ -219,11 +239,16 @@ class AnchorLoader:
             chosen = [scales[0]] * len(batches)
         return list(zip(batches, chosen))
 
+    def _part(self, chunk: np.ndarray) -> np.ndarray:
+        """This process's contiguous row slice of a global batch."""
+        bl = self.batch_size // self.num_parts
+        return chunk[self.part_index * bl:(self.part_index + 1) * bl]
+
     def _produce(self, plan) -> Iterator[Dict[str, np.ndarray]]:
         for chunk, scale in plan:
             yield _stack([_load_record(self.roidb[i], self.cfg, scale,
                                        with_masks=True)
-                          for i in chunk])
+                          for i in self._part(chunk)])
 
     def __iter__(self):
         plan = self._epoch_plan()  # RNG on the consumer thread only
@@ -278,8 +303,10 @@ class ROIIter:
     sampled in-graph by ``rcnn_train``."""
 
     def __init__(self, roidb: list, cfg: Config, batch_size: int,
-                 shuffle: bool = True, seed: int = 0):
-        self._inner = AnchorLoader(roidb, cfg, batch_size, shuffle, seed)
+                 shuffle: bool = True, seed: int = 0,
+                 num_parts: int = 1, part_index: int = 0):
+        self._inner = AnchorLoader(roidb, cfg, batch_size, shuffle, seed,
+                                   num_parts=num_parts, part_index=part_index)
         self.cfg = cfg
         self.batch_size = batch_size
         self.put = None  # same double-buffering hook as AnchorLoader
@@ -313,7 +340,7 @@ class ROIIter:
         def produce():
             for chunk, scale in plan:
                 samples = []
-                for i in chunk:
+                for i in self._inner._part(chunk):
                     rec = self._inner.roidb[i]
                     s = _load_record(rec, cfg, scale)
                     props = np.asarray(rec.get("proposals",

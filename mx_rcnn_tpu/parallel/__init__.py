@@ -8,6 +8,9 @@ multi-slice), batch sharded over data, params replicated, gradient
 all-reduce performed by XLA-inserted collectives.
 """
 
+from mx_rcnn_tpu.parallel.distributed import (assert_loader_partition,
+                                               init_distributed,
+                                               local_row_range, sync)
 from mx_rcnn_tpu.parallel.mesh import (MeshPlan, check_spatial, make_mesh,
                                         make_multislice_mesh, shard_batch,
                                         shard_stacked_batch)

@@ -283,7 +283,18 @@ def shard_batch(plan: MeshPlan, batch):
     ``work_load_list`` ctx split, minus the host copy per device: a single
     `device_put` with a sharding does the scatter.  On a spatial mesh the
     ``images`` entry additionally splits its height rows over ``space``
-    (``MeshPlan.images``)."""
+    (``MeshPlan.images``).
+
+    On a mesh spanning several processes (multi-host — see
+    ``parallel/distributed.py``) each process passes only ITS rows of the
+    global batch (the loader's ``num_parts``/``part_index`` slice) and the
+    global arrays are assembled per-shard; the single-process fast path is
+    one ``device_put`` scatter."""
+    from mx_rcnn_tpu.parallel.distributed import (global_from_local,
+                                                  is_multiprocess_mesh)
+
+    if is_multiprocess_mesh(plan.mesh):
+        return global_from_local(plan, batch)
     sh = plan.batch()
     if isinstance(batch, dict):
         im_sh = plan.images()
@@ -309,7 +320,14 @@ def shard_stacked_batch(plan: MeshPlan, batches):
     """Place a STACK of k host batches (every leaf (k, batch, ...)) onto
     the mesh for ``make_multi_train_step``: the leading stack axis stays
     unsharded, the batch axis splits over the data axes, and ``images``
-    additionally splits height over ``space`` when present."""
+    additionally splits height over ``space`` when present.  Multi-process
+    meshes assemble global arrays from each process's rows, like
+    ``shard_batch``."""
+    from mx_rcnn_tpu.parallel.distributed import (global_from_local,
+                                                  is_multiprocess_mesh)
+
+    if is_multiprocess_mesh(plan.mesh):
+        return global_from_local(plan, batches, stacked=True)
     sh = stack_sharding(plan.batch())
     if isinstance(batches, dict):
         im_sh = stack_sharding(plan.images())

@@ -47,6 +47,20 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
                              "(e.g. --cfg tpu__SCALES='((64,96),)' "
                              "--cfg TRAIN__BATCH_ROIS=32)")
     if train:
+        # multi-host (the reference's unscripted KVStore('dist_sync') tier,
+        # scripted here — parallel/distributed.py): every process runs the
+        # same command with its own --dist-process-id; --dist-auto on TPU
+        # pods.  Train-only: eval drivers reject these at argparse level
+        # (multi-process eval is not supported — run it single-process).
+        parser.add_argument("--dist-auto", action="store_true",
+                            help="join a TPU-pod distributed runtime "
+                                 "(topology auto-detected)")
+        parser.add_argument("--dist-coordinator", default=None,
+                            metavar="HOST:PORT",
+                            help="distributed coordinator address "
+                                 "(non-pod multi-host)")
+        parser.add_argument("--dist-num-processes", type=int, default=None)
+        parser.add_argument("--dist-process-id", type=int, default=None)
         parser.add_argument("--pretrained", default="",
                             help=".npz backbone/params path (converted)")
         parser.add_argument("--pretrained_epoch", type=int, default=0)
@@ -155,11 +169,47 @@ def get_train_roidb(imdb, cfg: Config, roidb=None):
     return imdb.filter_roidb(roidb)
 
 
+def init_dist_from_args(args) -> tuple:
+    """``--dist-*`` → ``init_distributed``; returns (process_index,
+    process_count).  Must run before anything queries devices."""
+    from mx_rcnn_tpu.parallel import init_distributed
+
+    return init_distributed(
+        coordinator_address=getattr(args, "dist_coordinator", None),
+        num_processes=getattr(args, "dist_num_processes", None),
+        process_id=getattr(args, "dist_process_id", None),
+        auto=getattr(args, "dist_auto", False))
+
+
 def make_plan(args) -> Optional[MeshPlan]:
     n = args.devices if args.devices > 0 else len(jax.devices())
     if n <= 1:
         return None
     return make_mesh(jax.devices()[:n], data=n)
+
+
+def setup_parallel(args):
+    """Distributed rendezvous (``--dist-*``) THEN mesh plan — in that
+    order, since the plan must see the global topology.  Returns
+    ``(plan, process_index, process_count)``; every train driver that
+    supports multi-host goes through here so the flags can never be
+    silently ignored."""
+    pidx, pcount = init_dist_from_args(args)
+    plan = make_plan(args)
+    if pcount > 1 and plan is None:
+        raise ValueError(
+            "multi-process run resolved to a single-device plan; pass "
+            "--devices covering every host's devices (or 0 for all)")
+    return plan, pidx, pcount
+
+
+def check_dist_loader(plan, batch_size: int, pcount: int, pidx: int) -> None:
+    """Multi-host loader sanity: the contiguous ``num_parts`` slice must be
+    the rows this process's mesh shards hold (no-op single-process)."""
+    if pcount > 1:
+        from mx_rcnn_tpu.parallel import assert_loader_partition
+
+        assert_loader_partition(plan, batch_size, pcount, pidx)
 
 
 def init_or_load_params(args, cfg: Config, model, batch_size: int,

@@ -10,17 +10,17 @@ from mx_rcnn_tpu.data import AnchorLoader
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.tools.common import (CappedLoader, add_common_args,
-                                      config_from_args, get_imdb,
-                                      get_train_roidb, init_or_load_params,
-                                      make_plan)
+                                      check_dist_loader, config_from_args,
+                                      get_imdb, get_train_roidb,
+                                      init_or_load_params, setup_parallel)
 from mx_rcnn_tpu.train import fit
 
 
 def train_rpn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
     """Callable both as a CLI stage and from train_alternate (which passes
     params of the previous stage and frozen_shared=True for round 2)."""
+    plan, pidx, pcount = setup_parallel(args)
     cfg = cfg or config_from_args(args, train=True)
-    plan = make_plan(args)
     n_dev = plan.n_data if plan else 1
     batch_size = (getattr(args, "batch_images", None)
                   or n_dev * cfg.TRAIN.BATCH_IMAGES)
@@ -30,7 +30,9 @@ def train_rpn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
     if roidb is None:
         imdb = get_imdb(args, cfg)
         roidb = get_train_roidb(imdb, cfg)
-    loader = AnchorLoader(roidb, cfg, batch_size, shuffle=cfg.TRAIN.SHUFFLE)
+    loader = AnchorLoader(roidb, cfg, batch_size, shuffle=cfg.TRAIN.SHUFFLE,
+                          num_parts=pcount, part_index=pidx)
+    check_dist_loader(plan, batch_size, pcount, pidx)
     if getattr(args, "num_steps", 0):
         loader = CappedLoader(loader, args.num_steps)
     model = build_model(cfg)

@@ -89,7 +89,8 @@ class CheckpointManager:
             payload["opt_state"] = jax.device_get(opt_state)
         self._mgr.save(epoch, args=ocp.args.StandardSave(payload))
         self._mgr.wait_until_finished()
-        logger.info("Saved checkpoint epoch %d -> %s", epoch, self.prefix)
+        if jax.process_index() == 0:
+            logger.info("Saved checkpoint epoch %d -> %s", epoch, self.prefix)
 
     def load_epoch(self, epoch: int, cfg, for_training: bool = True,
                    abstract_payload=None):

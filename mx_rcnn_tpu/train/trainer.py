@@ -113,6 +113,14 @@ def fit(cfg: Config, model, params, train_loader,
         logger.info("resumed from %s epoch %d (step %d)", prefix, begin_epoch,
                     r_step)
 
+    if plan is not None:
+        # multi-host: create the mesh's cross-process communicator NOW,
+        # while ranks are aligned — its lazy creation inside the first
+        # step would race the ranks' compile-time skew against the Gloo
+        # key-exchange deadline (see warm_collectives; no-op otherwise)
+        from mx_rcnn_tpu.parallel.distributed import warm_collectives
+
+        warm_collectives(plan)
     step_fn = make_train_step(model, tx, plan=plan, graph=graph,
                               trainable_mask=mask)
     k = int(steps_per_dispatch)
@@ -126,8 +134,14 @@ def fit(cfg: Config, model, params, train_loader,
         train_loader.put = ((lambda b: shard_batch(plan, b))
                             if plan is not None else jax.device_put)
     n_chips = plan.n_data if plan else 1
+    # multi-host (parallel/distributed.py): every process runs this same
+    # loop over the global mesh in lockstep; only process 0 speaks/saves.
+    # The loader carries its num_parts/part_index row slice; metrics are
+    # replicated outputs, so the fetch below is a local read everywhere.
+    proc0 = jax.process_index() == 0
     speedo = Speedometer(train_loader.batch_size, frequent=frequent,
                          n_chips=n_chips)
+    speedo_cb = speedo if proc0 else (lambda *a, **k: None)
     bank = MetricBank()
     key = jax.random.PRNGKey(seed)
 
@@ -183,7 +197,7 @@ def fit(cfg: Config, model, params, train_loader,
             if (i + 1) % frequent == 0 and pending is not None:
                 bank.update(jax.device_get(pending))
                 pending = None
-            speedo(epoch, i, bank.format())
+            speedo_cb(epoch, i, bank.format())
         if buf:  # epoch remainder (< k) — flushed AFTER the loop so the
             # drain cannot depend on steps_per_epoch matching the
             # iterator's true yield count (wrapper loaders may differ)
@@ -201,10 +215,25 @@ def fit(cfg: Config, model, params, train_loader,
             logger.info("wrote device trace to %s", profile_dir)
         if pending is not None:
             bank.update(jax.device_get(pending))
-        logger.info("Epoch[%d] Train-%s", epoch,
-                    bank.format().replace("\t", " Train-"))
+        if proc0:
+            logger.info("Epoch[%d] Train-%s", epoch,
+                        bank.format().replace("\t", " Train-"))
         if ckpt is not None:
+            # multi-host: EVERY rank calls save — orbax's CheckpointManager
+            # runs its own cross-process barriers inside save() and writes
+            # from the primary host only (ranks must share one prefix on a
+            # shared filesystem).  Gating this on rank 0 deadlocks orbax's
+            # sync_global_devices (found by the two-process CLI drive).
+            # State leaves are replicated (DP) so device_get is local.
             ckpt.save_epoch(epoch + 1, state.params, cfg,
                             opt_state=state.opt_state,
                             step=int(jax.device_get(state.step)))
+    if jax.process_count() > 1:
+        # align ranks before returning: after the last collective nothing
+        # else synchronizes them, and a rank that exits the process much
+        # later than its peers trips the jax.distributed SHUTDOWN barrier
+        # deadline under load (observed with Gloo on a contended host)
+        from mx_rcnn_tpu.parallel.distributed import sync
+
+        sync("fit_end")
     return state

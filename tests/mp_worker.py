@@ -1,0 +1,96 @@
+"""Worker for tests/test_multiprocess.py — one process of a REAL
+two-process CPU run (Gloo collectives), or the single-process control.
+
+Runs a short ``fit`` on deterministic synthetic data over an 8-device
+global mesh and prints a digest of the final state.  Invoked as:
+
+    python tests/mp_worker.py <process_id> <num_processes> <port>
+
+num_processes=1 is the control: same global mesh (8 local devices), same
+data, no distributed runtime.  Every RNG input is pinned (loader seed,
+fit seed, init key), so the multi-process run must reproduce the control
+up to collective reduction order (asserted allclose by the test; the two
+worker ranks must match each other bit-for-bit).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# 4 local devices per process in the 2-process run, 8 in the control —
+# the GLOBAL mesh is 8 devices either way
+N_LOCAL = {2: 4, 1: 8}
+
+
+def main(pid: int, nproc: int, port: int):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={N_LOCAL[nproc]}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # PER-RANK compile cache: a shared cache makes hit/miss asymmetric
+    # between ranks, skewing their compile finish times; the Gloo clique
+    # rendezvous (first collective) tolerates only ~30 s of skew on top
+    # of the init_distributed warmup barrier.  A per-rank dir keeps every
+    # rank's cache behavior identical run to run.
+    cache = os.environ.get("JAX_TEST_CACHE", "/tmp/jax_test_cache")
+    jax.config.update("jax_compilation_cache_dir", f"{cache}_mp{nproc}_{pid}")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    if nproc > 1:
+        from mx_rcnn_tpu.parallel import init_distributed
+
+        init_distributed(coordinator_address=f"localhost:{port}",
+                         num_processes=nproc, process_id=pid)
+    import dataclasses
+
+    import numpy as np
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.data import AnchorLoader, SyntheticDataset
+    from mx_rcnn_tpu.models import build_model, init_params
+    from mx_rcnn_tpu.parallel import assert_loader_partition, make_mesh
+    from mx_rcnn_tpu.train import fit
+
+    assert len(jax.devices()) == 8, jax.devices()
+
+    cfg = generate_config(
+        "resnet50", "PascalVOC",
+        TRAIN__RPN_PRE_NMS_TOP_N=200, TRAIN__RPN_POST_NMS_TOP_N=32,
+        TRAIN__BATCH_ROIS=16, TRAIN__FLIP=False,
+    )
+    net = dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4),
+                              PIXEL_STDS=(127.0, 127.0, 127.0))
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=4)
+    cfg = cfg.replace(network=net, tpu=tpu)
+
+    roidb = SyntheticDataset(num_images=16, num_classes=cfg.NUM_CLASSES,
+                             height=64, width=96, seed=0).gt_roidb()
+    loader = AnchorLoader(roidb, cfg, batch_size=8, shuffle=True, seed=0,
+                          num_parts=nproc, part_index=pid)
+    plan = make_mesh(data=8)
+    assert_loader_partition(plan, 8, nproc, pid)
+
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (64, 96))
+    state = fit(cfg, model, params, loader, begin_epoch=0, end_epoch=1,
+                plan=plan, frequent=1, seed=0)
+
+    flat, _ = jax.tree_util.tree_flatten(jax.device_get(state.params))
+    digest = float(sum(np.float64(np.abs(x).sum()) for x in flat))
+    probe = np.asarray(
+        state.params["rpn"]["rpn_conv_3x3"]["kernel"]).ravel()[:4]
+    print(f"DIGEST {digest:.10e}", flush=True)
+    print("PROBE " + " ".join(f"{v:.10e}" for v in probe), flush=True)
+    if nproc > 1:
+        from mx_rcnn_tpu.parallel import sync
+
+        # the digest work above runs per-rank unsynchronized; align before
+        # interpreter teardown so the atexit shutdown barrier sees both
+        # ranks together even on a heavily loaded host
+        sync("worker_done")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))

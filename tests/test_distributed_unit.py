@@ -1,0 +1,72 @@
+"""Unit coverage for the multi-host partition logic that doesn't need a
+second process (the live two-process run is tests/test_multiprocess.py):
+loader ``num_parts`` slicing vs the full loader, row-range math on the
+single-process mesh, and the init_distributed argument guard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import AnchorLoader, SyntheticDataset
+from mx_rcnn_tpu.parallel import (assert_loader_partition, init_distributed,
+                                  local_row_range, make_mesh)
+
+
+def _cfg():
+    cfg = generate_config("resnet50", "PascalVOC", TRAIN__FLIP=False)
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((64, 96),), MAX_GT=4)
+    return cfg.replace(tpu=tpu)
+
+
+def _batches(loader):
+    return [{k: np.asarray(v) for k, v in b.items()} for b in loader]
+
+
+def test_loader_parts_slice_the_global_batches():
+    """Two part-loaders with the same seed yield exactly the row halves of
+    the full loader's batches, in the same order — the lockstep-schedule
+    invariant multi-host training rests on."""
+    cfg = _cfg()
+    roidb = SyntheticDataset(num_images=12, num_classes=cfg.NUM_CLASSES,
+                             height=64, width=96, seed=3).gt_roidb()
+    full = _batches(AnchorLoader(roidb, cfg, 4, shuffle=True, seed=7))
+    p0 = _batches(AnchorLoader(roidb, cfg, 4, shuffle=True, seed=7,
+                               num_parts=2, part_index=0))
+    p1 = _batches(AnchorLoader(roidb, cfg, 4, shuffle=True, seed=7,
+                               num_parts=2, part_index=1))
+    assert len(full) == len(p0) == len(p1) == 3
+    for bf, b0, b1 in zip(full, p0, p1):
+        for k in bf:
+            np.testing.assert_array_equal(bf[k][:2], b0[k])
+            np.testing.assert_array_equal(bf[k][2:], b1[k])
+
+
+def test_loader_part_validation():
+    cfg = _cfg()
+    roidb = SyntheticDataset(num_images=4, num_classes=cfg.NUM_CLASSES,
+                             height=64, width=96, seed=0).gt_roidb()
+    with pytest.raises(ValueError, match="divide"):
+        AnchorLoader(roidb, cfg, 4, num_parts=3)
+    with pytest.raises(ValueError, match="part_index"):
+        AnchorLoader(roidb, cfg, 4, num_parts=2, part_index=2)
+
+
+def test_local_row_range_single_process_covers_everything():
+    plan = make_mesh(data=8)
+    assert local_row_range(plan, 16) == (0, 16)
+    # num_parts=1 partition trivially matches
+    assert_loader_partition(plan, 16, 1, 0)
+    with pytest.raises(ValueError, match="does not divide"):
+        local_row_range(plan, 12)
+
+
+def test_init_distributed_rejects_partial_triple():
+    with pytest.raises(ValueError, match="partial --dist"):
+        init_distributed(process_id=1)
+    with pytest.raises(ValueError, match="partial --dist"):
+        init_distributed(num_processes=2, process_id=0)

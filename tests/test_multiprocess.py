@@ -1,0 +1,92 @@
+"""REAL multi-process (multi-host) training — the `dist_sync` tier.
+
+Spawns two OS processes, each owning 4 virtual CPU devices, joined into
+one 8-device global mesh by ``jax.distributed`` (Gloo collectives), and
+runs the full ``fit`` loop — AnchorLoader with the ``num_parts`` row
+partition, global-array batch assembly (``global_from_local``), XLA
+cross-process gradient all-reduce, process-0-only logging/checkpoint
+gating — then checks against a single-process 8-device control run on
+the SAME global data and seeds:
+
+* the two ranks end bit-identical (replicated state really is replicated
+  across processes);
+* multi-process final params match the single-process control (allclose:
+  cross-process Gloo all-reduce may round differently than the
+  single-process reduction).
+
+This is the strongest multi-host evidence the environment can produce
+without a second TPU host; on a pod the same code path is
+``train_end2end.py --dist-auto`` (reference: SURVEY §2.2 KVStore
+``dist_sync`` row — upstream left it unscripted).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+
+WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
+TIMEOUT = 900
+
+
+def _run(pid: int, nproc: int, port: int) -> subprocess.Popen:
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(pid), str(nproc), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+
+
+def _parse(out: str):
+    digest = float(re.search(r"DIGEST (\S+)", out).group(1))
+    probe = np.asarray(
+        [float(v) for v in re.search(r"PROBE (.+)", out).group(1).split()])
+    return digest, probe
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_fit_matches_single_process():
+    port = _free_port()
+    workers = [_run(i, 2, port) for i in range(2)]
+    outs = []
+    try:
+        for i, p in enumerate(workers):
+            out, _ = p.communicate(timeout=TIMEOUT)
+            outs.append(out.decode())
+        for i, p in enumerate(workers):
+            assert p.returncode == 0, f"rank {i} failed:\n{outs[i][-4000:]}"
+    finally:
+        for p in workers:  # a crashed rank must not orphan its peer
+            if p.poll() is None:
+                p.kill()
+
+    control_p = _run(0, 1, port)
+    try:
+        out, _ = control_p.communicate(timeout=TIMEOUT)
+    finally:
+        if control_p.poll() is None:
+            control_p.kill()
+    control_out = out.decode()
+    assert control_p.returncode == 0, control_out[-4000:]
+
+    d0, p0 = _parse(outs[0])
+    d1, p1 = _parse(outs[1])
+    dc, pc = _parse(control_out)
+
+    # ranks are bit-identical (the state is one replicated global array)
+    assert d0 == d1 and np.array_equal(p0, p1), (d0, d1, p0, p1)
+    # multi-process == single-process control up to reduction order
+    np.testing.assert_allclose(p0, pc, rtol=1e-5, atol=1e-7)
+    assert abs(d0 - dc) / max(abs(dc), 1.0) < 1e-5, (d0, dc)

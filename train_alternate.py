@@ -42,6 +42,16 @@ def parse_args():
 
 
 def alternate_train(args):
+    if (getattr(args, "dist_auto", False)
+            or getattr(args, "dist_coordinator", None) is not None
+            or getattr(args, "dist_num_processes", None) is not None
+            or getattr(args, "dist_process_id", None) is not None):
+        raise NotImplementedError(
+            "alternate training is single-process: stages 2/5 dump "
+            "proposals through the eval path, which has no multi-host "
+            "mode.  Run the train stages multi-host individually "
+            "(tools/train_rpn.py, tools/train_rcnn.py --dist-*) or use "
+            "train_end2end.py --dist-*")
     cfg = config_from_args(args, train=True)
     if cfg.network.HAS_MASK:
         raise NotImplementedError(

@@ -9,7 +9,12 @@ contract, --resume).
 
 TPU specifics: ``--devices N`` picks the data-mesh size (the ``--gpus``
 equivalent); ``--synthetic`` trains on generated data with zero files on
-disk; ``--num-steps`` caps steps for smoke runs.
+disk; ``--num-steps`` caps steps for smoke runs.  Multi-host (the
+reference's unscripted ``KVStore('dist_sync')`` tier): run the same
+command on every host with ``--dist-auto`` (TPU pod) or the
+``--dist-coordinator/--dist-num-processes/--dist-process-id`` triple —
+each process loads its slice of every global batch and XLA's collectives
+do the cross-host gradient reduce (``parallel/distributed.py``).
 """
 
 from __future__ import annotations
@@ -22,9 +27,9 @@ from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.data import AnchorLoader
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.tools.common import (CappedLoader, add_common_args,
-                                      config_from_args, get_imdb,
-                                      get_train_roidb, init_or_load_params,
-                                      make_plan)
+                                      check_dist_loader, config_from_args,
+                                      get_imdb, get_train_roidb,
+                                      init_or_load_params, setup_parallel)
 from mx_rcnn_tpu.train import fit
 
 
@@ -42,8 +47,9 @@ def parse_args():
 
 
 def train_net(args):
+    # rendezvous before anything can touch the jax backend
+    plan, pidx, pcount = setup_parallel(args)
     cfg = config_from_args(args, train=True)
-    plan = make_plan(args)
     n_dev = plan.n_data if plan else 1
     batch_size = args.batch_images or n_dev * cfg.TRAIN.BATCH_IMAGES
     if plan and batch_size % n_dev:
@@ -53,7 +59,9 @@ def train_net(args):
     imdb = get_imdb(args, cfg)
     roidb = get_train_roidb(imdb, cfg)
     loader = AnchorLoader(roidb, cfg, batch_size,
-                          shuffle=cfg.TRAIN.SHUFFLE)
+                          shuffle=cfg.TRAIN.SHUFFLE,
+                          num_parts=pcount, part_index=pidx)
+    check_dist_loader(plan, batch_size, pcount, pidx)
     if args.num_steps:
         loader = CappedLoader(loader, args.num_steps)
     logger.info("training on %d images, %d steps/epoch, batch %d over %d "
