@@ -61,6 +61,10 @@ def init_distributed(coordinator_address: Optional[str] = None,
     the ranks and establishes the clique while the window is easy.
     """
     triple = (coordinator_address, num_processes, process_id)
+    if auto and any(v is not None for v in triple):
+        raise ValueError(
+            "auto=True (pod auto-detection) cannot be combined with an "
+            "explicit coordinator triple — pick one form")
     if not auto and any(v is not None for v in triple) \
             and not all(v is not None for v in triple):
         # a partial triple must not fall through to a standalone run (other

@@ -36,7 +36,9 @@ TIMEOUT = 900
 def _run(pid: int, nproc: int, port: int) -> subprocess.Popen:
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    repo = os.path.dirname(os.path.dirname(__file__))
+    prior = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = repo + (os.pathsep + prior if prior else "")
     return subprocess.Popen(
         [sys.executable, WORKER, str(pid), str(nproc), str(port)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
