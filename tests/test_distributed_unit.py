@@ -27,23 +27,31 @@ def _batches(loader):
     return [{k: np.asarray(v) for k, v in b.items()} for b in loader]
 
 
-def test_loader_parts_slice_the_global_batches():
-    """Two part-loaders with the same seed yield exactly the row halves of
-    the full loader's batches, in the same order — the lockstep-schedule
-    invariant multi-host training rests on."""
-    cfg = _cfg()
-    roidb = SyntheticDataset(num_images=12, num_classes=cfg.NUM_CLASSES,
-                             height=64, width=96, seed=3).gt_roidb()
-    full = _batches(AnchorLoader(roidb, cfg, 4, shuffle=True, seed=7))
-    p0 = _batches(AnchorLoader(roidb, cfg, 4, shuffle=True, seed=7,
-                               num_parts=2, part_index=0))
-    p1 = _batches(AnchorLoader(roidb, cfg, 4, shuffle=True, seed=7,
-                               num_parts=2, part_index=1))
-    assert len(full) == len(p0) == len(p1) == 3
+def _assert_parts_slice_global(make_loader, n_batches: int,
+                               expect_key: str = None):
+    """Core partition contract: two part-loaders with the same seed yield
+    exactly the row halves of the full loader's batches, in the same
+    order — the lockstep-schedule invariant multi-host training rests
+    on.  ``make_loader(**part_kwargs)`` builds the loader under test."""
+    full = _batches(make_loader())
+    p0 = _batches(make_loader(num_parts=2, part_index=0))
+    p1 = _batches(make_loader(num_parts=2, part_index=1))
+    assert len(full) == len(p0) == len(p1) == n_batches
     for bf, b0, b1 in zip(full, p0, p1):
+        if expect_key is not None:
+            assert expect_key in bf
         for k in bf:
             np.testing.assert_array_equal(bf[k][:2], b0[k])
             np.testing.assert_array_equal(bf[k][2:], b1[k])
+
+
+def test_loader_parts_slice_the_global_batches():
+    cfg = _cfg()
+    roidb = SyntheticDataset(num_images=12, num_classes=cfg.NUM_CLASSES,
+                             height=64, width=96, seed=3).gt_roidb()
+    _assert_parts_slice_global(
+        lambda **kw: AnchorLoader(roidb, cfg, 4, shuffle=True, seed=7, **kw),
+        n_batches=3)
 
 
 def test_loader_part_validation():
@@ -70,3 +78,40 @@ def test_init_distributed_rejects_partial_triple():
         init_distributed(process_id=1)
     with pytest.raises(ValueError, match="partial --dist"):
         init_distributed(num_processes=2, process_id=0)
+    with pytest.raises(ValueError, match="cannot be combined"):
+        init_distributed(coordinator_address="h:1", num_processes=2,
+                         process_id=0, auto=True)
+
+
+def test_roiiter_parts_slice_the_global_batches():
+    """ROIIter (the Fast-RCNN loader) partitions like AnchorLoader —
+    including the per-record proposals payload."""
+    from mx_rcnn_tpu.data import ROIIter
+
+    cfg = _cfg()
+    roidb = SyntheticDataset(num_images=8, num_classes=cfg.NUM_CLASSES,
+                             height=64, width=96, seed=1).gt_roidb()
+    rng = np.random.RandomState(0)
+    for r in roidb:
+        r["proposals"] = np.abs(rng.rand(5, 4).astype(np.float32)) * 30
+    _assert_parts_slice_global(
+        lambda **kw: ROIIter(roidb, cfg, 4, shuffle=True, seed=9, **kw),
+        n_batches=2, expect_key="rois")
+
+
+def test_sync_and_warm_collectives_single_process_noop():
+    """sync() returns immediately single-process (without consuming
+    barrier ids), and warm_collectives on a local mesh is a cached
+    no-op — both sit on the fit path for every plan."""
+    from mx_rcnn_tpu.parallel.distributed import (_sync_counter, sync,
+                                                  warm_collectives)
+
+    before = _sync_counter[0]
+    sync("unit_test")
+    assert _sync_counter[0] == before  # no-op must not advance the
+    # lockstep counter: a rank-dependent advance would desync real jobs
+    plan = make_mesh(data=8)
+    warm_collectives(plan)
+    hits_before = warm_collectives.cache_info().hits
+    warm_collectives(plan)
+    assert warm_collectives.cache_info().hits == hits_before + 1
