@@ -187,7 +187,8 @@ def make_multi_train_step(model, tx: optax.GradientTransformation, k: int,
                           plan: Optional[MeshPlan] = None,
                           graph: str = "end2end",
                           donate: bool = True,
-                          trainable_mask=None) -> Callable:
+                          trainable_mask=None,
+                          unroll: Optional[bool] = None) -> Callable:
     """``k`` train steps in ONE dispatched program: ``lax.scan`` over
     batches stacked on a leading axis (every leaf shaped (k, ...)).
 
@@ -208,19 +209,39 @@ def make_multi_train_step(model, tx: optax.GradientTransformation, k: int,
     MEAN over the k steps (the per-step values feed the same MetricBank
     averaging that single-step fit samples at Speedometer cadence).
     Parity is tested in tests/test_train.py.
-    """
+
+    ``unroll``: pass ``unroll=k`` to ``lax.scan`` (straight-line body
+    repetition instead of a compiled loop).  Default: unrolled on the CPU
+    backend, rolled loop elsewhere.  Values are identical either way
+    (same scan semantics); the split exists because XLA:CPU's compile
+    time for a scan-of-train-step under SPMD is pathological — measured
+    round 5: >17 min at 8 partitions and >25 min in one 2-partition
+    config on a host that compiles the same step standalone in 29 s —
+    while on TPU the rolled loop is both fine to compile and the point
+    of the feature (the loop-body layout win, above)."""
     if k < 1:
         raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+    if unroll is None:
+        unroll = jax.default_backend() == "cpu"
     if plan is not None:
         check_spatial(plan, model.cfg)
     step = _build_step(model, tx, graph, trainable_mask)
 
     def multi(state: TrainState, batches, key):
+        if k == 1:
+            # no scan at k=1: same values (fold_in(key, 0); mean over one
+            # step is identity), and the scan construct itself is what
+            # XLA:CPU compiles pathologically under SPMD (unroll=k cannot
+            # help a length-1 loop)
+            return step(state, jax.tree.map(lambda x: x[0], batches),
+                        jax.random.fold_in(key, 0))
+
         def body(st, xs):
             i, b = xs
             return step(st, b, jax.random.fold_in(key, i))
 
-        state, ms = jax.lax.scan(body, state, (jnp.arange(k), batches))
+        state, ms = jax.lax.scan(body, state, (jnp.arange(k), batches),
+                                 unroll=k if unroll else 1)
         return state, jax.tree.map(lambda x: jnp.mean(x, axis=0), ms)
 
     if plan is None:

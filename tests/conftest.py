@@ -20,15 +20,27 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# persistent compile cache: full-model CPU compiles dominate suite runtime
-cache_dir = os.environ.get("JAX_TEST_CACHE", "/tmp/jax_test_cache")
-jax.config.update("jax_compilation_cache_dir", cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 assert jax.devices()[0].platform == "cpu", jax.devices()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+import pathlib  # noqa: E402
+import sys  # noqa: E402
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from __graft_entry__ import machine_cache_dir  # noqa: E402
+
+# persistent compile cache (full-model CPU compiles dominate suite
+# runtime), keyed by machine fingerprint: entries AOT-compiled on a
+# different host are rejected at load (and risk SIGILL) — the round-4
+# driver run was poisoned exactly this way.  machine_cache_dir reads
+# JAX_TEST_CACHE for the base dir; __graft_entry__'s import already set
+# this config, re-stated here so the suite does not depend on that
+# module-level side effect.
+jax.config.update("jax_compilation_cache_dir", machine_cache_dir())
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 @pytest.fixture
