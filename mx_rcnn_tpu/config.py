@@ -238,6 +238,13 @@ class TPUConfig:
     ROI_MODE: str = "avg"
     # host→device prefetch depth
     PREFETCH: int = 2
+    # rematerialize the backbone stages in the backward pass
+    # (nn.remat on each ResNetStage): trades recompute FLOPs for HBM
+    # traffic — the B>=16 lever for the measured relu-backward
+    # compare_select slowdown once per-tensor working sets pass ~40 MB
+    # (BASELINE.md batch-scaling table).  Param tree and numerics are
+    # unchanged; off by default pending the on-chip A/B.
+    REMAT_BACKBONE: bool = False
 
 
 @dataclass(frozen=True)

@@ -223,21 +223,28 @@ class ResNetConv(nn.Module):
     depth: str = "resnet50"
     dtype: jnp.dtype = jnp.bfloat16
     all_stages: bool = False
+    # remat: recompute each stage's activations in the backward pass
+    # (cfg.tpu.REMAT_BACKBONE) — only stage INPUTS are saved, so the
+    # large relu/add activations never round-trip HBM between fwd and
+    # bwd; params and numerics are identical (nn.remat is a lifted
+    # transform — scope names pass through)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x):
         units = RESNET_UNITS[self.depth]
+        Stage = nn.remat(ResNetStage) if self.remat else ResNetStage
         x = x.astype(self.dtype)
         sc1, sh1 = FrozenBN(dtype=self.dtype, features=64, name="bn1")()
         x = StemConvS2D(dtype=self.dtype, name="conv1")(x, sc1, sh1)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
-        c2 = ResNetStage(units[0], 64, 1, dtype=self.dtype, name="stage1")(x)
-        c3 = ResNetStage(units[1], 128, 2, dtype=self.dtype, name="stage2")(c2)
-        c4 = ResNetStage(units[2], 256, 2, dtype=self.dtype, name="stage3")(c3)
+        c2 = Stage(units[0], 64, 1, dtype=self.dtype, name="stage1")(x)
+        c3 = Stage(units[1], 128, 2, dtype=self.dtype, name="stage2")(c2)
+        c4 = Stage(units[2], 256, 2, dtype=self.dtype, name="stage3")(c3)
         if not self.all_stages:
             return c4  # stride 16, 1024 ch — the classic single-level feature
-        c5 = ResNetStage(units[3], 512, 2, dtype=self.dtype, name="stage4")(c4)
+        c5 = Stage(units[3], 512, 2, dtype=self.dtype, name="stage4")(c4)
         return c2, c3, c4, c5
 
 

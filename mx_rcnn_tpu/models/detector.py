@@ -50,7 +50,8 @@ class FasterRCNN(nn.Module):
         dtype = jnp.bfloat16 if self.cfg.tpu.COMPUTE_DTYPE == "bfloat16" else jnp.float32
         self._dtype = dtype
         if net.NETWORK.startswith("resnet"):
-            self.backbone = ResNetConv(depth=net.NETWORK, dtype=dtype)
+            self.backbone = ResNetConv(depth=net.NETWORK, dtype=dtype,
+                                       remat=self.cfg.tpu.REMAT_BACKBONE)
             self.head_body = ResNetStage5(depth=net.NETWORK, dtype=dtype)
             self._pooled = 14  # reference: ROIPooling 14×14 → stage5 stride 2 → 7×7
         elif net.NETWORK == "vgg16":

@@ -89,7 +89,8 @@ class FPNFasterRCNN(nn.Module):
         self._dtype = dtype
         assert net.NETWORK.startswith("resnet"), "FPN requires a ResNet body"
         self.backbone = ResNetConv(depth=net.NETWORK, dtype=dtype,
-                                   all_stages=True)
+                                   all_stages=True,
+                                   remat=self.cfg.tpu.REMAT_BACKBONE)
         self.neck = FPNNeck(out_channels=net.FPN_OUT_CHANNELS, dtype=dtype)
         # FPN's shared RPN head is FPN_OUT_CHANNELS (256) wide — the FPN
         # paper/Detectron convention (the classic C4 RPN uses 512); at P2

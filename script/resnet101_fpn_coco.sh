@@ -3,8 +3,12 @@
 # Expects COCO under data/coco (train2017/val2017 + annotations) and a
 # converted backbone at model/resnet101.npz (utils/convert_torch.py).
 set -e
+# --steps-per-dispatch 4: the FPN step drops 21.95 -> 17.85 ms inside a
+# scanned multi-step program (better P2-conv layout; BASELINE.md round-4
+# ledger), and group assembly rides the prefetch thread so the transfer
+# overlap of k=1 is kept
 python train_end2end.py --network resnet101_fpn --dataset coco \
-  --pretrained model/resnet101.npz \
+  --pretrained model/resnet101.npz --steps-per-dispatch 4 \
   --prefix model/fpn_coco --end_epoch 7 --lr 0.00125 --lr_step 5,6 "$@"
 python test.py --network resnet101_fpn --dataset coco \
   --prefix model/fpn_coco --epoch 7

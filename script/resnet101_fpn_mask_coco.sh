@@ -3,8 +3,11 @@
 # Mask configs train end2end only (the alternate pipeline has no
 # mask-target path — see models/fpn.py:rcnn_train).
 set -e
+# --steps-per-dispatch 4: same scanned-dispatch layout win as the FPN
+# recipe (the mask graph shares the pyramid; measured on the FPN step,
+# BASELINE.md round-4 ledger)
 python train_end2end.py --network resnet101_fpn_mask --dataset coco \
-  --pretrained model/resnet101.npz \
+  --pretrained model/resnet101.npz --steps-per-dispatch 4 \
   --prefix model/mask_coco --end_epoch 7 --lr 0.00125 --lr_step 5,6 "$@"
 python test.py --network resnet101_fpn_mask --dataset coco \
   --prefix model/mask_coco --epoch 7
