@@ -80,6 +80,13 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
                                  "dropout); loader shuffle uses its own")
         parser.add_argument("--num-steps", type=int, default=0, dest="num_steps",
                             help="cap steps per epoch (smoke runs)")
+        parser.add_argument("--steps-per-dispatch", type=int, default=1,
+                            help="train steps per dispatched program "
+                                 "(lax.scan grouping; >1 amortizes dispatch "
+                                 "overhead and lets XLA compile the step as "
+                                 "a loop body — see train/trainer.py fit "
+                                 "docstring; applies to every fit-based "
+                                 "driver, alternate stages included)")
     else:
         parser.add_argument("--epoch", type=int, default=10,
                             help="checkpoint epoch to load")

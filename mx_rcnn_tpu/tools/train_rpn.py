@@ -45,7 +45,8 @@ def train_rpn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
                 begin_epoch=args.begin_epoch, end_epoch=args.end_epoch,
                 plan=plan, prefix=getattr(args, "prefix", None), graph="rpn",
                 seed=getattr(args, "seed", 0),
-                frequent=args.frequent, fixed_prefixes=fixed)
+                frequent=args.frequent, fixed_prefixes=fixed,
+                steps_per_dispatch=getattr(args, "steps_per_dispatch", 1))
     return state
 
 

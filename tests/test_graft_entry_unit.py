@@ -1,0 +1,33 @@
+"""Driver-entry plumbing that must not regress silently: the
+machine-fingerprinted compile-cache key and the dryrun case registry's
+structural invariants (round-5 redesign — see __graft_entry__ docstring
+for the rc=124 history these encode)."""
+
+import os
+
+from __graft_entry__ import _CASES, machine_cache_dir
+
+
+def test_machine_cache_dir_is_deterministic_and_keyed():
+    a = machine_cache_dir("/tmp/base")
+    b = machine_cache_dir("/tmp/base")
+    assert a == b, "fingerprint must be stable within a machine"
+    assert a.startswith("/tmp/base" + os.sep)
+    leaf = os.path.basename(a)
+    assert len(leaf) == 12 and all(c in "0123456789abcdef" for c in leaf)
+    # a different base relocates, same fingerprint
+    assert os.path.basename(machine_cache_dir("/tmp/other")) == leaf
+
+
+def test_case_registry_invariants():
+    names = [c[0] for c in _CASES]
+    assert len(set(names)) == len(names)
+    # flat_dp must stay first: it always runs (budget check exempts it)
+    # and multislice asserts against its loss
+    assert names[0] == "flat_dp"
+    assert names.index("multislice") > 0
+    for name, fn, min_dev, need_even, units in _CASES:
+        assert callable(fn), name
+        assert min_dev >= 1 and units > 0, name
+    # priority order is the VERDICT-prescribed certification order
+    assert names[1:3] == ["fpn_dp*sp", "mask_dp*tp"], names

@@ -38,11 +38,8 @@ def parse_args():
     add_common_args(parser, train=True)
     parser.add_argument("--profile", default="",
                         help="write an XProf device trace of early steps here")
-    parser.add_argument("--steps-per-dispatch", type=int, default=1,
-                        help="train steps per dispatched program (lax.scan "
-                             "grouping; >1 amortizes dispatch overhead and "
-                             "lets XLA compile the step as a loop body — "
-                             "see train/trainer.py fit docstring)")
+    # --steps-per-dispatch comes from add_common_args (shared with the
+    # alternate-training stage tools since round 5)
     return parser.parse_args()
 
 
