@@ -23,12 +23,15 @@ method for comparison.
 
 Extra modes (manual, for BASELINE.md's scaling/honesty tables — each also
 prints one JSON line):
-  python bench.py --batch 4              # staged train step at B=4
+  python bench.py --batch 4              # chain train step at B=4
   python bench.py --mode loader          # loader-INCLUSIVE train: real
       AnchorLoader over a synthetic roidb (cv2 resize, host s2d, prefetch
       thread with on-thread device transfer — all in the measured loop;
       the Speedometer-equivalent number)
-  python bench.py --mode infer --batch 4 # staged inference (predict chain)
+  python bench.py --mode infer --batch 4 # chain inference (round 5;
+      --legacy-dispatch selects the staged method in BOTH train and
+      infer modes; infer output carries a "method" field so ledger rows
+      are never cross-method-compared silently)
   python bench.py --mode infer-loader    # TestLoader + im_detect loop incl.
       per-image host decode/readback (the test.py loop without class NMS)
 """
@@ -147,6 +150,35 @@ def make_chain_fn(step, dbatch, key=None):
     return chain
 
 
+def _differenced_rate(run, batch: int, fallback):
+    """Shared timing protocol of the chain benches (train + infer): time
+    the warmed ``run(n)`` at both CHAIN lengths in 3 pairs, skip pairs a
+    window hiccup inverted, and difference so the dispatch + readback
+    fence cancels exactly:
+
+        imgs/s = (n2 - n1) * batch / (t(n2) - t(n1))
+
+    Median of 3 valid pairs; LOWER-middle when pairs were skipped — with
+    2 samples the upper-middle is max-of-noise, the exact selection bias
+    the round-4 rewrite exists to kill (see CHAIN_N note).  ``fallback``
+    runs the staged method when every pair inverts (pathological
+    window).  ``run(n)`` must block on a real readback before returning.
+    """
+    n1, n2 = CHAIN_N1, CHAIN_N2
+    rates = []
+    for _ in range(3):
+        ts = {}
+        for n in (n1, n2):
+            t0 = time.time()
+            run(n)
+            ts[n] = time.time() - t0
+        if ts[n2] > ts[n1]:
+            rates.append((n2 - n1) * batch / (ts[n2] - ts[n1]))
+    if not rates:
+        return fallback()
+    return sorted(rates)[(len(rates) - 1) // 2]
+
+
 def bench_train_chain(batch: int, network: str = "resnet101"):
     """One-dispatch chained-step timing — the headline method since round 4.
 
@@ -182,27 +214,17 @@ def bench_train_chain(batch: int, network: str = "resnet101"):
 
     n1, n2 = CHAIN_N1, CHAIN_N2
     s0 = int(jax.device_get(state.step))
-    for n in (n1, n2):  # compile + warm both lengths
-        state = chain(state, n)
-    s1 = int(jax.device_get(state.step))  # full round-trip fence
-    assert s1 - s0 == n1 + n2, f"chain ran {s1 - s0} steps, not {n1 + n2}"
+    box = [state]
 
-    rates = []
-    for _ in range(3):
-        ts = {}
-        for n in (n1, n2):
-            t0 = time.time()
-            state = chain(state, n)
-            _ = int(jax.device_get(state.step))
-            ts[n] = time.time() - t0
-        if ts[n2] > ts[n1]:  # a window hiccup can invert the pair; skip it
-            rates.append((n2 - n1) * batch / (ts[n2] - ts[n1]))
-    if not rates:  # every pair inverted (pathological window): fall back
-        return bench_train_staged(batch, network)
-    # median for 3 valid pairs; LOWER-middle when pairs were skipped —
-    # with 2 samples the upper-middle is max-of-noise, the exact
-    # selection bias this rewrite exists to kill (see CHAIN_N note)
-    return sorted(rates)[(len(rates) - 1) // 2]
+    def run(n):
+        box[0] = chain(box[0], n)
+        return int(jax.device_get(box[0].step))  # readback = fence
+
+    for n in (n1, n2):  # compile + warm both lengths
+        s1 = run(n)
+    assert s1 - s0 == n1 + n2, f"chain ran {s1 - s0} steps, not {n1 + n2}"
+    return _differenced_rate(run, batch,
+                             lambda: bench_train_staged(batch, network))
 
 
 def bench_train_staged(batch: int, network: str = "resnet101"):
@@ -281,6 +303,50 @@ def build_infer(batch: int, network: str = "resnet101"):
     params = init_params(model, cfg, jax.random.PRNGKey(0), batch, (H, W))
     params = denormalize_for_save(params, cfg)
     return Predictor(model, params, cfg), cfg
+
+
+def bench_infer_chain(batch: int, network: str = "resnet101"):
+    """One-dispatch chained inference timing (round 5) — the same
+    differenced ``lax.fori_loop`` construction as ``bench_train_chain``
+    (whose docstring carries the method's full story), applied to the
+    ``model.predict`` forward.  Inference has no carried state, so the
+    loop carries a f32 sum folded over every output leaf (keeps the body
+    alive under DCE); per-iteration epsilon image noise poisons
+    loop-invariant hoisting exactly as in the train chain.  Falls back
+    to the staged method when every timing pair inverts
+    (pathological window)."""
+    from functools import partial
+
+    import jax.numpy as jnp
+
+    pred, cfg = build_infer(batch, network)
+    hbatch = synthetic_batch(cfg, batch)
+    images = jax.device_put(hbatch["images"])
+    im_info = jax.device_put(hbatch["im_info"])
+    model, params = pred.model, pred.params
+    key = jax.random.PRNGKey(0)
+
+    @partial(jax.jit, static_argnames=("n",))
+    def chain(n):
+        def body(i, acc):
+            k = jax.random.fold_in(key, i)
+            imgs = images + jax.random.uniform(
+                k, (), dtype=images.dtype, maxval=1e-3)
+            out = model.apply({"params": params}, imgs, im_info,
+                              method=model.predict)
+            return acc + sum(jnp.sum(x.astype(jnp.float32))
+                             for x in jax.tree.leaves(out))
+
+        return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+    def run(n):
+        return float(jax.device_get(chain(n)))  # readback = fence
+
+    for n in (CHAIN_N1, CHAIN_N2):  # compile + warm both lengths
+        acc = run(n)
+    assert np.isfinite(acc)
+    return _differenced_rate(run, batch,
+                             lambda: bench_infer_staged(batch, network))
 
 
 def bench_infer_staged(batch: int, network: str = "resnet101"):
@@ -370,9 +436,10 @@ def main():
                          "--cfg TRAIN__RPN_ASSIGN_IOU_BF16=True — for "
                          "A/B step-time measurements of ledger levers")
     ap.add_argument("--legacy-dispatch", action="store_true",
-                    help="train mode: use the pre-round-4 async-dispatch "
-                         "chain (subject to tunnel dispatch-rate noise) "
-                         "instead of the one-dispatch fori_loop chain")
+                    help="train AND infer modes: use the staged "
+                         "async-dispatch method (subject to tunnel "
+                         "dispatch-rate noise) instead of the "
+                         "one-dispatch fori_loop chain")
     args = ap.parse_args()
     from mx_rcnn_tpu.tools.common import parse_cfg_overrides
 
@@ -382,6 +449,7 @@ def main():
         args.network = ("resnet101_fpn_mask" if args.mode == "infer-mask"
                         else "resnet101")
 
+    infer_method = None
     if args.mode == "train":
         fn = bench_train_staged if args.legacy_dispatch else bench_train_chain
         value = fn(args.batch, args.network)
@@ -390,8 +458,14 @@ def main():
         value = bench_train_loader(args.batch, args.network)
         metric = "train_imgs_per_sec_loader_inclusive"
     elif args.mode == "infer":
-        value = bench_infer_staged(args.batch, args.network)
+        fn = bench_infer_staged if args.legacy_dispatch else bench_infer_chain
+        value = fn(args.batch, args.network)
         metric = "infer_imgs_per_sec"
+        # name the method in the artifact: the staged-method BASELINE.md
+        # rows share this metric name, and a chain number silently
+        # compared against them would cross methods (the train path
+        # guards this with value/value_chain + baseline_method)
+        infer_method = "staged" if args.legacy_dispatch else "chain"
     elif args.mode == "infer-mask":
         value = bench_infer_mask(args.batch, args.network)
         metric = "infer_imgs_per_sec_mask_eval"
@@ -442,6 +516,8 @@ def main():
     }
     if baseline_method is not None:
         out["baseline_method"] = baseline_method
+    if infer_method is not None:
+        out["method"] = infer_method
     print(json.dumps(out))
 
 
