@@ -75,3 +75,44 @@ def test_bench_vs_baseline_is_method_consistent(monkeypatch, capsys,
     rec = run(["bench.py", "--mode", "train", "--legacy-dispatch"])
     assert rec["vs_baseline"] == round(10.0 / 5.0, 3)
     assert rec["baseline_method"] == "staged"
+
+
+def test_differenced_rate_protocol(monkeypatch):
+    """The shared chain-timing protocol (_differenced_rate): differenced
+    pairs, inverted-pair skip, lower-median, and the staged fallback when
+    every pair inverts — now load-bearing for BOTH chain benches."""
+    import bench
+
+    monkeypatch.setattr(bench, "CHAIN_N1", 10)
+    monkeypatch.setattr(bench, "CHAIN_N2", 30)
+    t = {"now": 0.0}
+    monkeypatch.setattr(bench.time, "time", lambda: t["now"])
+
+    # run(n) costs 0.1 s fixed dispatch + n*0.05 s: rate = 20*1/(1.0) = 20
+    def run(n):
+        t["now"] += 0.1 + n * 0.05
+
+    assert bench._differenced_rate(run, 1, lambda: -1.0) == 20.0
+
+    # one inverted pair (hiccup on the long leg) is skipped, not
+    # averaged, and with the two survivors at DIFFERENT rates the
+    # LOWER-middle is returned (upper-middle would be max-of-noise —
+    # the round-4 selection bias the protocol exists to kill)
+    calls = {"i": 0}
+
+    def run_hiccup(n):
+        calls["i"] += 1
+        if calls["i"] == 2:  # first pair's n2 leg: absurdly fast (invert)
+            t["now"] += 0.01
+        elif calls["i"] <= 4:  # second pair: per-step 0.05 -> rate 20.0
+            t["now"] += 0.1 + n * 0.05
+        else:  # third pair: per-step 0.04 -> rate 25.0
+            t["now"] += 0.1 + n * 0.04
+
+    assert bench._differenced_rate(run_hiccup, 1, lambda: -1.0) == 20.0
+
+    # every pair inverted -> staged fallback
+    def run_bad(n):
+        t["now"] += 0.5 if n == 10 else 0.1
+
+    assert bench._differenced_rate(run_bad, 1, lambda: -1.0) == -1.0
