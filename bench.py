@@ -440,6 +440,12 @@ def main():
                          "async-dispatch method (subject to tunnel "
                          "dispatch-rate noise) instead of the "
                          "one-dispatch fori_loop chain")
+    ap.add_argument("--telemetry-dir", default="", dest="telemetry_dir",
+                    help="stream the run's telemetry (JSONL events + "
+                         "summary JSON) here; the loader/infer-loader/"
+                         "infer-mask modes emit the same per-phase spans "
+                         "as real training/eval (the instrumented loader "
+                         "and tester run inside the measured loop)")
     args = ap.parse_args()
     from mx_rcnn_tpu.tools.common import parse_cfg_overrides
 
@@ -448,7 +454,16 @@ def main():
         # per-mode default: an explicitly passed network is never rewritten
         args.network = ("resnet101_fpn_mask" if args.mode == "infer-mask"
                         else "resnet101")
+    from mx_rcnn_tpu import telemetry
 
+    if args.telemetry_dir:
+        telemetry.configure(args.telemetry_dir,
+                            run_meta={"driver": "bench", "mode": args.mode,
+                                      "batch": args.batch,
+                                      "network": args.network})
+
+    tel = telemetry.get()
+    t_bench = time.perf_counter()
     infer_method = None
     if args.mode == "train":
         fn = bench_train_staged if args.legacy_dispatch else bench_train_chain
@@ -472,6 +487,10 @@ def main():
     else:
         value = bench_infer_loader(args.batch, args.network)
         metric = "infer_imgs_per_sec_loader_inclusive"
+    # whole-mode wall (warmup + compile + timed loops) and the headline
+    # result, in the run's own schema — the loader/tester phase spans from
+    # the measured loop land in the same stream
+    tel.add(f"bench/{args.mode}", time.perf_counter() - t_bench)
     if args.batch != 1:
         metric += f"_b{args.batch}"
     if args.network != "resnet101":
@@ -518,6 +537,10 @@ def main():
         out["baseline_method"] = baseline_method
     if infer_method is not None:
         out["method"] = infer_method
+    if args.telemetry_dir:
+        tel.gauge(f"bench/{metric}", value)
+        tel.write_summary(extra={"bench": out})
+        telemetry.shutdown()
     print(json.dumps(out))
 
 

@@ -19,10 +19,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.image import (get_image, resize_to_bucket,
                                     space_to_depth2, transform_image)
@@ -85,26 +87,55 @@ class _Prefetcher:
     ``jax.device_put`` (with the mesh sharding when data-parallel) here, so
     the host→device copy is in flight while the previous step computes;
     ``device_put`` only enqueues the transfer, so the producer thread never
-    blocks on the device."""
+    blocks on the device.
+
+    Telemetry (active sink at construction; the no-op sink costs one
+    attribute check per batch): producer-side ``loader/produce`` (host
+    batch assembly), ``loader/put_transfer`` (the ``put`` hook — the
+    device transfer when double-buffering) and ``loader/queue_full_wait``
+    (producer blocked on a full queue = consumer is the bottleneck);
+    consumer-side ``loader/queue_depth`` gauge sampled at every get (a
+    persistently empty queue = producer is the bottleneck)."""
 
     def __init__(self, gen, depth: int, put=None):
         self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._err = None
         self._stop = threading.Event()
+        self._tel = telemetry.get()
+
+        def enqueue(item) -> bool:
+            """Blocking put that honors close(); False once stopped."""
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def run():
+            tel = self._tel
             try:
-                for item in gen:
-                    if put is not None:
-                        item = put(item)
-                    while not self._stop.is_set():
-                        try:
-                            self._q.put(item, timeout=0.2)
-                            break
-                        except queue.Full:
-                            continue
-                    if self._stop.is_set():
-                        return
+                if not tel.enabled:  # untimed hot path: one check per epoch
+                    for item in gen:
+                        if put is not None:
+                            item = put(item)
+                        if not enqueue(item):
+                            return
+                else:
+                    t_prod = time.perf_counter()
+                    for item in gen:
+                        tel.add("loader/produce",
+                                time.perf_counter() - t_prod)
+                        if put is not None:
+                            with tel.span("loader/put_transfer"):
+                                item = put(item)
+                        t_full = time.perf_counter()
+                        if not enqueue(item):
+                            return
+                        tel.add("loader/queue_full_wait",
+                                time.perf_counter() - t_full)
+                        t_prod = time.perf_counter()
             except BaseException as e:  # surfaced on the consumer side
                 self._err = e
             finally:
@@ -127,8 +158,13 @@ class _Prefetcher:
         self._stop.set()
 
     def __iter__(self):
+        tel = self._tel
         try:
             while True:
+                if tel.enabled:
+                    # sampled BEFORE the blocking get: a persistently-zero
+                    # depth means the consumer outruns the producer
+                    tel.gauge("loader/queue_depth", self._q.qsize())
                 item = self._q.get()
                 if item is None:
                     if self._err is not None:

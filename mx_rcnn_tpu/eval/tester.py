@@ -19,6 +19,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
+from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.loader import TestLoader
 from mx_rcnn_tpu.logger import logger
@@ -234,20 +235,26 @@ def im_detect(predictor: Predictor, batch: dict):
     train/checkpoint.py) or live training params passed through
     ``denormalize_for_save`` first.
     """
-    rois, roi_valid, cls_prob, bbox_deltas, _ = predictor.predict(
-        batch["images"], batch["im_info"])
-    rois, roi_valid, cls_prob, bbox_deltas = jax.device_get(
-        (rois, roi_valid, cls_prob, bbox_deltas))
+    tel = telemetry.get()
+    # phase split: "forward" is the async dispatch (cheap unless compile),
+    # "readback" is where the host actually waits on the device
+    with tel.span("eval/forward"):
+        rois, roi_valid, cls_prob, bbox_deltas, _ = predictor.predict(
+            batch["images"], batch["im_info"])
+    with tel.span("eval/readback"):
+        rois, roi_valid, cls_prob, bbox_deltas = jax.device_get(
+            (rois, roi_valid, cls_prob, bbox_deltas))
     im_info = np.asarray(batch["im_info"])
 
     out = []
     n = int(np.sum(batch.get("batch_valid", np.ones(len(rois), bool))))
-    for b in range(n):
-        eh, ew, s = im_info[b]
-        boxes = decode_boxes(rois[b], bbox_deltas[b])  # (R, 4K)
-        boxes = clip_boxes(boxes, eh, ew)
-        boxes = np.asarray(boxes) / s                  # original frame
-        out.append((cls_prob[b], boxes, roi_valid[b]))
+    with tel.span("eval/decode"):
+        for b in range(n):
+            eh, ew, s = im_info[b]
+            boxes = decode_boxes(rois[b], bbox_deltas[b])  # (R, 4K)
+            boxes = clip_boxes(boxes, eh, ew)
+            boxes = np.asarray(boxes) / s                  # original frame
+            out.append((cls_prob[b], boxes, roi_valid[b]))
     return out
 
 
@@ -268,6 +275,12 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
     ``det_cache``: pickle the final ``all_boxes`` there (the reference
     writes ``detections.pkl`` into the imdb cache; ``tools/reeval.py``
     re-scores it without a model or device).
+
+    Phase telemetry (whatever sink is active — ``mx_rcnn_tpu/telemetry``):
+    per-batch ``eval/loader_wait`` / ``eval/forward`` / ``eval/readback``
+    / ``eval/decode`` / ``eval/nms`` (+ ``eval/mask_pass``) spans, an
+    ``eval/imgs_per_sec`` gauge and an ``eval/images`` counter — the same
+    JSONL schema as the train stream, so one report folds both.
     """
     cfg = predictor.cfg
     if max_per_image is None:
@@ -308,14 +321,23 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
     all_masks: Optional[List[List]] = (
         [[None for _ in range(num_images)] for _ in range(num_classes)]
         if with_masks else None)
-    t0 = time.time()
+    tel = telemetry.get()
+    t0 = time.perf_counter()
     done = 0
-    for batch in test_loader:
+    it = iter(test_loader)
+    while True:
+        t_wait = time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            break
+        tel.add("eval/loader_wait", time.perf_counter() - t_wait)
         dets = im_detect(predictor, batch)
         # the pyramid predict() just cached belongs to THIS batch; the
         # token pins the mask pass to it (stale-cache guard)
         tok = getattr(predictor, "feats_token", None)
         indices = batch["indices"]
+        t_nms = time.perf_counter()
         for b, (scores, boxes, valid) in enumerate(dets):
             i = int(indices[b])
             v = np.asarray(valid, bool)
@@ -345,14 +367,18 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
                      for k in range(num_classes)],
                     imdb.classes, os.path.join(vis_dir, f"{i:06d}.jpg"))
             done += 1
+        tel.add("eval/nms", time.perf_counter() - t_nms, n=len(dets))
         if with_masks:
-            _mask_pass(predictor, batch, dets, all_boxes, all_masks,
-                       test_loader.roidb, max_per_image, num_classes,
-                       token=tok)
+            with tel.span("eval/mask_pass"):
+                _mask_pass(predictor, batch, dets, all_boxes, all_masks,
+                           test_loader.roidb, max_per_image, num_classes,
+                           token=tok)
         if done % 100 < len(dets):
-            rate = max(done, 1) / (time.time() - t0)
+            rate = max(done, 1) / (time.perf_counter() - t0)
+            tel.gauge("eval/imgs_per_sec", rate)
             logger.info("im_detect: %d/%d  %.3fs/im  %.1f imgs/s (%.1f/chip)",
                         done, num_images, 1.0 / rate, rate, rate / n_chips)
+    tel.counter("eval/images", done)
     if det_cache:
         # write-then-rename so det_cache is only ever complete or absent;
         # pid-suffixed tmp so concurrent evals can't interleave, unlinked

@@ -1,13 +1,44 @@
-"""Repo-wide logger (reference: ``rcnn/logger.py`` — module-level logging setup)."""
+"""Repo-wide logger (reference: ``rcnn/logger.py`` — module-level logging
+setup, made idempotent and rank-aware).
+
+The reference calls ``logging.basicConfig`` unconditionally at import,
+which silently does nothing when the embedding application configured
+logging first, and stacks duplicate handlers under repeated re-imports in
+some harnesses.  Here ``setup_logging`` owns exactly one stream handler:
+it is installed only if the root logger has none (an application's own
+configuration is never stomped), and repeated calls just refresh the
+formatter — so calling it again with ``rank=jax.process_index()`` after a
+multi-host rendezvous (``parallel.distributed.init_distributed`` does
+this) prefixes every record with ``rank{N}``, making interleaved
+multi-host logs attributable to their process.
+"""
 
 import logging
+from typing import Optional
 
-logging.basicConfig(
-    format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    level=logging.INFO,
-)
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+_handler: Optional[logging.StreamHandler] = None
+
 logger = logging.getLogger("mx_rcnn_tpu")
-logger.setLevel(logging.INFO)
 
-# orbax/absl emit per-checkpoint INFO spam; keep driver output readable
-logging.getLogger("absl").setLevel(logging.WARNING)
+
+def setup_logging(rank: Optional[int] = None) -> None:
+    """Idempotent handler/formatter setup; ``rank`` adds a ``rank{N}``
+    record prefix (multi-host attribution).  Safe to call any number of
+    times from any driver."""
+    global _handler
+    root = logging.getLogger()
+    if _handler is None and not root.handlers:
+        _handler = logging.StreamHandler()
+        root.addHandler(_handler)
+    if root.level > logging.INFO or root.level == logging.NOTSET:
+        root.setLevel(logging.INFO)
+    if _handler is not None:
+        fmt = _FORMAT if rank is None else f"rank{rank} {_FORMAT}"
+        _handler.setFormatter(logging.Formatter(fmt))
+    logger.setLevel(logging.INFO)
+    # orbax/absl emit per-checkpoint INFO spam; keep driver output readable
+    logging.getLogger("absl").setLevel(logging.WARNING)
+
+
+setup_logging()

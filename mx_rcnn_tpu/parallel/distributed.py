@@ -90,6 +90,14 @@ def init_distributed(coordinator_address: Optional[str] = None,
     elif jax.process_count() == 1:
         # single process, nothing requested: plain local run
         return 0, 1
+    if jax.process_count() > 1:
+        # rank{N}-prefix every log record from here on: multi-host logs
+        # interleave on shared consoles/files, and an unattributed line is
+        # useless in a deadlock post-mortem (idempotent — logger.py owns
+        # exactly one handler)
+        from mx_rcnn_tpu.logger import setup_logging
+
+        setup_logging(rank=jax.process_index())
     if warmup and jax.process_count() > 1:
         sync("init_distributed_warmup")
     return jax.process_index(), jax.process_count()

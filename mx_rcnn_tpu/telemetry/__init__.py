@@ -1,0 +1,62 @@
+"""Telemetry layer — structured run-time instrumentation for the whole
+stack (SURVEY §5's "free win" the MXNet reference never had).
+
+Dependency-free (stdlib only — no jax import, so the data layer's
+producer threads and host-only tools can emit without touching the
+backend).  One module-global active sink, because the instrumented code
+is cross-cutting: the trainer, the loader's prefetch thread, the
+Speedometer and the eval loop all record into whatever run is active
+without threading a handle through every constructor.
+
+    from mx_rcnn_tpu import telemetry
+
+    telemetry.configure(out_dir, rank=jax.process_index(),
+                        world=jax.process_count())
+    with telemetry.get().span("train/dispatch"):
+        ...
+    telemetry.get().counter("train/recompile")
+    telemetry.shutdown()   # close the event file, restore the no-op sink
+
+Unconfigured, ``get()`` returns the shared :data:`NULL` no-op sink —
+instrumented hot paths pay one attribute check and zero allocations.
+Drivers expose this as ``--telemetry-dir`` (per-rank event files on
+multi-host, summary JSON from process 0 only — the ``profile_dir``
+rank-split contract); ``scripts/telemetry_report.py`` folds the files
+back into the human table and BENCH-compatible rows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from mx_rcnn_tpu.telemetry.sink import (NULL, SCHEMA_VERSION, SUMMARY_NAME,
+                                        NullTelemetry, Telemetry)
+
+__all__ = ["Telemetry", "NullTelemetry", "NULL", "SCHEMA_VERSION",
+           "SUMMARY_NAME", "configure", "get", "shutdown"]
+
+_active: "NullTelemetry | Telemetry" = NULL
+
+
+def configure(out_dir: str, rank: int = 0, world: int = 1,
+              run_meta: Optional[dict] = None) -> Telemetry:
+    """Open a run's sink and make it the active one.  Reconfiguring over a
+    live sink closes it first (one active run per process — matching the
+    one-event-file-per-rank layout)."""
+    global _active
+    if _active.enabled:
+        _active.close()
+    _active = Telemetry(out_dir, rank=rank, world=world, run_meta=run_meta)
+    return _active
+
+
+def get() -> "NullTelemetry | Telemetry":
+    """The active sink (the no-op :data:`NULL` when none is configured)."""
+    return _active
+
+
+def shutdown():
+    """Close the active sink and restore the no-op default."""
+    global _active
+    _active.close()
+    _active = NULL

@@ -1,0 +1,158 @@
+"""Fold telemetry JSONL event streams into the aggregated summary, the
+human table, and ``BENCH_*.json``-compatible metric rows.
+
+Library half of ``scripts/telemetry_report.py`` (importable so tests and
+other tools fold without a subprocess).  Input is any mix of event files
+and run directories; a directory expands to every ``events_rank*.jsonl``
+inside it, so the multi-host case (one file per rank, mirroring the
+``profile_dir`` rank-split) folds into ONE cross-rank aggregate — span
+totals/counters sum over ranks, gauge extrema span all ranks.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Iterable, List
+
+from mx_rcnn_tpu.telemetry.sink import SCHEMA_VERSION
+
+
+def event_files(paths: Iterable[str]) -> List[str]:
+    """Expand run dirs to their per-rank event files; pass files through."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p, "events_rank*.jsonl")))
+            if not found:
+                raise FileNotFoundError(
+                    f"no events_rank*.jsonl under {p} — was the run started "
+                    f"with --telemetry-dir?")
+            out.extend(found)
+        else:
+            out.append(p)
+    return out
+
+
+def load_events(paths: Iterable[str]) -> List[dict]:
+    events = []
+    for path in event_files(paths):
+        with open(path) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise ValueError(f"{path}:{ln}: not a JSON object "
+                                     f"({e})") from None
+                events.append(rec)
+    return events
+
+
+def aggregate(events: Iterable[dict]) -> dict:
+    """Events → the ``Telemetry.summary()`` shape, cross-rank.
+
+    The fold is the same math the live sink keeps in memory, so a
+    single-rank run folds to byte-identical span/counter/gauge blocks —
+    the round-trip the schema test pins.
+    """
+    spans: dict = {}
+    counters: dict = {}
+    gauges: dict = {}
+    ranks = set()
+    meta: dict = {}
+    for e in events:
+        kind = e.get("kind")
+        name = e.get("name")
+        ranks.add(e.get("rank", 0))
+        if kind == "span":
+            d = float(e["dur_s"])
+            n = int(e.get("n", 1))
+            s = spans.get(name)
+            if s is None:
+                spans[name] = [n, d, d, d]
+            else:
+                s[0] += n
+                s[1] += d
+                s[2] = min(s[2], d)
+                s[3] = max(s[3], d)
+        elif kind == "counter":
+            counters[name] = counters.get(name, 0) + int(e["inc"])
+        elif kind == "gauge":
+            v = float(e["value"])
+            g = gauges.get(name)
+            if g is None:
+                gauges[name] = [1, v, v, v, v]
+            else:
+                g[0] += 1
+                g[1] += v
+                g[2] = min(g[2], v)
+                g[3] = max(g[3], v)
+                g[4] = v
+        elif kind == "meta" and name == "run" and not meta:
+            meta = dict(e.get("fields", {}))
+    return {
+        "schema": SCHEMA_VERSION,
+        "ranks": sorted(ranks),
+        "meta": meta,
+        "spans": {k: {"count": c, "total_s": t, "mean_s": t / max(c, 1),
+                      "min_s": lo, "max_s": hi}
+                  for k, (c, t, lo, hi) in sorted(spans.items())},
+        "counters": dict(sorted(counters.items())),
+        "gauges": {k: {"count": c, "mean": t / max(c, 1), "min": lo,
+                       "max": hi, "last": last}
+                   for k, (c, t, lo, hi, last) in sorted(gauges.items())},
+    }
+
+
+def render_table(summary: dict) -> str:
+    """The human view: spans ranked by total time, then counters/gauges."""
+    lines = []
+    ranks = summary.get("ranks")
+    if ranks:
+        lines.append(f"ranks: {','.join(str(r) for r in ranks)}")
+    spans = summary.get("spans", {})
+    if spans:
+        lines.append(f"{'span':<34}{'count':>8}{'total_s':>10}"
+                     f"{'mean_ms':>10}{'max_ms':>10}")
+        for name, s in sorted(spans.items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"{name:<34}{s['count']:>8}{s['total_s']:>10.3f}"
+                         f"{s['mean_s'] * 1e3:>10.3f}"
+                         f"{s['max_s'] * 1e3:>10.3f}")
+    counters = summary.get("counters", {})
+    if counters:
+        lines.append("")
+        lines.append(f"{'counter':<34}{'total':>8}")
+        for name, v in counters.items():
+            lines.append(f"{name:<34}{v:>8}")
+    gauges = summary.get("gauges", {})
+    if gauges:
+        lines.append("")
+        lines.append(f"{'gauge':<34}{'count':>8}{'mean':>10}{'min':>10}"
+                     f"{'max':>10}{'last':>10}")
+        for name, g in gauges.items():
+            lines.append(f"{name:<34}{g['count']:>8}{g['mean']:>10.3f}"
+                         f"{g['min']:>10.3f}{g['max']:>10.3f}"
+                         f"{g['last']:>10.3f}")
+    return "\n".join(lines)
+
+
+def bench_rows(summary: dict) -> List[dict]:
+    """Rate gauges → ``BENCH_*.json``-compatible metric rows (the
+    ``{"metric", "value", "unit"}`` shape bench.py prints), so a telemetry
+    run can feed the bench ledger without a separate measurement pass.
+    A rate gauge is one whose name contains ``imgs_per_sec`` (the
+    Speedometer feed, pred_eval's rate, and bench's own result gauge,
+    whose suffixed names carry batch/network tags)."""
+    rows = []
+    for name, g in summary.get("gauges", {}).items():
+        if "imgs_per_sec" in name:
+            rows.append({"metric": name.replace("/", "_"),
+                         "value": round(g["mean"], 3),
+                         "unit": "imgs/sec",
+                         "samples": g["count"]})
+    return rows

@@ -1,0 +1,253 @@
+"""The telemetry sink: monotonic-clock spans, counters, gauges, and a
+rank-aware JSONL event stream with an end-of-run aggregated summary.
+
+SURVEY §5 calls a profiling subsystem "the free win" the MXNet reference
+never had; until now every perf claim in the ledger was reconstructed by
+hand from session logs (BASELINE.md's r4_tpu_session*.log archaeology).
+This layer makes the numbers a machine-readable artifact of every run:
+
+* ``Telemetry`` — the live sink.  ``span(name)`` times a block on
+  ``time.perf_counter`` (monotonic — wall-clock steps under NTP slew
+  corrupt durations, the Speedometer bug this PR also fixes);
+  ``counter``/``gauge`` record occurrences and sampled values.  Every
+  record is appended to ``events_rank{N}.jsonl`` (one JSON object per
+  line, schema below) and folded into in-memory aggregates that
+  ``summary()``/``write_summary()`` expose without re-reading the file.
+* ``NullTelemetry`` — the disabled sink.  All methods are no-ops and
+  ``span`` returns one cached context manager, so an instrumented hot
+  path pays a single attribute check and zero allocations per call.
+
+Thread-safety: the loader's prefetch producer thread emits events
+concurrently with the consumer loop, so the writer and the aggregate
+dicts share one lock.  Events are buffered by the underlying file object
+and flushed on ``close``/``write_summary`` — per-line fsyncs would put
+disk latency on the step path.
+
+JSONL event schema (``v`` = schema version, one object per line):
+
+    {"v": 1, "t": <unix wall seconds>, "rank": <process index>,
+     "kind": "span" | "counter" | "gauge" | "meta",
+     "name": "<dotted/slashed metric name>",
+     ...kind-specific fields}
+
+  span    → "dur_s": float seconds (optionally "n": batched count)
+  counter → "inc": int
+  gauge   → "value": float
+  meta    → free-form "fields" dict (run header: world size, argv, ...)
+
+``summary()`` aggregates per name: spans → count/total_s/mean_s/min_s/
+max_s, counters → total, gauges → count/mean/min/max/last.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+SCHEMA_VERSION = 1
+SUMMARY_NAME = "summary.json"
+
+
+class _NullSpan:
+    """Zero-allocation context manager for the disabled sink."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTelemetry:
+    """Disabled sink: one attribute check (``enabled``) on hot paths, no
+    allocations (``span`` hands back one cached context manager)."""
+
+    enabled = False
+    rank = 0
+
+    def span(self, name):
+        return _NULL_SPAN
+
+    def add(self, name, seconds, n=1):
+        pass
+
+    def counter(self, name, inc=1):
+        pass
+
+    def gauge(self, name, value):
+        pass
+
+    def meta(self, name, **fields):
+        pass
+
+    def summary(self) -> dict:
+        return {}
+
+    def write_summary(self, extra: Optional[dict] = None) -> Optional[str]:
+        return None
+
+    def close(self):
+        pass
+
+
+NULL = NullTelemetry()
+
+
+class _Span:
+    """Context manager recording a perf_counter duration into its sink."""
+
+    __slots__ = ("_tel", "_name", "_t0")
+
+    def __init__(self, tel: "Telemetry", name: str):
+        self._tel = tel
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tel.add(self._name, time.perf_counter() - self._t0)
+        return False
+
+
+class Telemetry:
+    """Live sink writing ``events_rank{rank}.jsonl`` under ``out_dir``.
+
+    ``rank``/``world`` mirror the multi-host contract of ``profile_dir``:
+    every rank streams its own file (no cross-process writer collisions on
+    a shared filesystem) and only process 0 calls ``write_summary``.
+    """
+
+    enabled = True
+
+    def __init__(self, out_dir: str, rank: int = 0, world: int = 1,
+                 run_meta: Optional[dict] = None, stream: bool = True):
+        self.out_dir = out_dir
+        self.rank = int(rank)
+        self.world = int(world)
+        self._lock = threading.Lock()
+        self._spans: dict = {}     # name -> [count, total, min, max]
+        self._counters: dict = {}  # name -> int
+        self._gauges: dict = {}    # name -> [count, total, min, max, last]
+        self._run_meta = dict(run_meta or {})
+        self._file = None
+        if stream:
+            os.makedirs(out_dir, exist_ok=True)
+            self.events_path = os.path.join(out_dir,
+                                            f"events_rank{self.rank}.jsonl")
+            self._file = open(self.events_path, "w")
+        if self._run_meta or stream:
+            self.meta("run", world=self.world, **self._run_meta)
+
+    # -- recording -------------------------------------------------------
+
+    def span(self, name: str) -> _Span:
+        return _Span(self, name)
+
+    def _emit(self, rec: dict):
+        if self._file is not None:
+            self._file.write(json.dumps(rec) + "\n")
+
+    def add(self, name: str, seconds: float, n: int = 1):
+        """Record a measured duration (the non-context-manager span form —
+        callers that already hold a perf_counter difference, e.g. the
+        trainer's loader-wait accumulation, feed it here).  ``n`` lets one
+        record stand for n back-to-back occurrences (group dispatches)."""
+        with self._lock:
+            s = self._spans.get(name)
+            if s is None:
+                self._spans[name] = [n, seconds, seconds, seconds]
+            else:
+                s[0] += n
+                s[1] += seconds
+                s[2] = min(s[2], seconds)
+                s[3] = max(s[3], seconds)
+            rec = {"v": SCHEMA_VERSION, "t": time.time(), "rank": self.rank,
+                   "kind": "span", "name": name, "dur_s": seconds}
+            if n != 1:
+                rec["n"] = n
+            self._emit(rec)
+
+    def counter(self, name: str, inc: int = 1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + inc
+            self._emit({"v": SCHEMA_VERSION, "t": time.time(),
+                        "rank": self.rank, "kind": "counter", "name": name,
+                        "inc": inc})
+
+    def gauge(self, name: str, value: float):
+        value = float(value)
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                self._gauges[name] = [1, value, value, value, value]
+            else:
+                g[0] += 1
+                g[1] += value
+                g[2] = min(g[2], value)
+                g[3] = max(g[3], value)
+                g[4] = value
+            self._emit({"v": SCHEMA_VERSION, "t": time.time(),
+                        "rank": self.rank, "kind": "gauge", "name": name,
+                        "value": value})
+
+    def meta(self, name: str, **fields):
+        with self._lock:
+            self._emit({"v": SCHEMA_VERSION, "t": time.time(),
+                        "rank": self.rank, "kind": "meta", "name": name,
+                        "fields": fields})
+
+    # -- reading ---------------------------------------------------------
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "schema": SCHEMA_VERSION,
+                "rank": self.rank,
+                "world": self.world,
+                "meta": dict(self._run_meta),
+                "spans": {
+                    k: {"count": c, "total_s": t, "mean_s": t / max(c, 1),
+                        "min_s": lo, "max_s": hi}
+                    for k, (c, t, lo, hi) in sorted(self._spans.items())},
+                "counters": dict(sorted(self._counters.items())),
+                "gauges": {
+                    k: {"count": c, "mean": t / max(c, 1), "min": lo,
+                        "max": hi, "last": last}
+                    for k, (c, t, lo, hi, last) in sorted(self._gauges.items())},
+            }
+
+    def write_summary(self, extra: Optional[dict] = None) -> Optional[str]:
+        """Write the aggregated summary JSON (call from process 0 only —
+        the multi-rank fold lives in ``scripts/telemetry_report.py``,
+        which reads every rank's event file)."""
+        doc = self.summary()
+        if extra:
+            doc.update(extra)
+        self.flush()
+        path = os.path.join(self.out_dir, SUMMARY_NAME)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    def flush(self):
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None

@@ -46,6 +46,11 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
                              "paths into the tree with python-literal values "
                              "(e.g. --cfg tpu__SCALES='((64,96),)' "
                              "--cfg TRAIN__BATCH_ROIS=32)")
+    parser.add_argument("--telemetry-dir", default="", dest="telemetry_dir",
+                        help="stream structured run telemetry here (JSONL "
+                             "events + summary JSON; per-rank files on "
+                             "multi-host, summary from process 0 only — "
+                             "fold with scripts/telemetry_report.py)")
     if train:
         # multi-host (the reference's unscripted KVStore('dist_sync') tier,
         # scripted here — parallel/distributed.py): every process runs the

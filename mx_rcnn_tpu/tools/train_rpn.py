@@ -46,6 +46,7 @@ def train_rpn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
                 plan=plan, prefix=getattr(args, "prefix", None), graph="rpn",
                 seed=getattr(args, "seed", 0),
                 frequent=args.frequent, fixed_prefixes=fixed,
+                telemetry_dir=getattr(args, "telemetry_dir", "") or None,
                 steps_per_dispatch=getattr(args, "steps_per_dispatch", 1))
     return state
 

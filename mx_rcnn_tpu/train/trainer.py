@@ -14,11 +14,13 @@ device on the current step's scalars.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
 import numpy as np
 
+from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.parallel.mesh import MeshPlan, shard_batch, shard_stacked_batch
@@ -89,6 +91,7 @@ def fit(cfg: Config, model, params, train_loader,
         frequent: int = 20,
         resume: bool = False,
         profile_dir: Optional[str] = None,
+        telemetry_dir: Optional[str] = None,
         steps_per_dispatch: int = 1,
         fixed_prefixes=None) -> TrainState:
     """Train ``model`` from ``params`` over ``train_loader`` epochs.
@@ -103,6 +106,17 @@ def fit(cfg: Config, model, params, train_loader,
     ``profile_dir``: capture an XProf/perfetto device trace of steps 3–8 of
     the first epoch (the reference has no profiling subsystem — SURVEY §5
     calls this the free win; view with xprof/tensorboard).
+
+    ``telemetry_dir``: stream structured run telemetry there (JSONL events
+    + an end-of-run summary JSON — see ``mx_rcnn_tpu/telemetry``): the
+    per-step wall-time breakdown (loader-wait / dispatch / metric-fetch
+    stall / checkpoint-save), epoch wall time, and a recompile counter
+    keyed on (program, batch bucket shape) so mixed-bucket epochs show
+    their true compile cost.  Per-rank event files on multi-host; the
+    summary is written by process 0 only (the ``profile_dir`` rank-split
+    contract).  When a sink is already active (a driver configured one),
+    it is reused and left open.  Disabled, every probe is a no-op sink
+    call — one attribute check, zero allocations.
 
     ``steps_per_dispatch`` > 1 groups k consecutive loader batches and
     runs them through ONE dispatched ``lax.scan`` program
@@ -127,6 +141,17 @@ def fit(cfg: Config, model, params, train_loader,
     # thin-shard guard lives in make_train_step (mechanism level); eval's is
     # in Predictor.__init__ since it never builds a train step
     steps_per_epoch = train_loader.steps_per_epoch
+    tel = telemetry.get()
+    owns_tel = False
+    if telemetry_dir and not tel.enabled:
+        tel = telemetry.configure(
+            telemetry_dir, rank=jax.process_index(),
+            world=jax.process_count(),
+            run_meta={"driver": "fit", "graph": graph,
+                      "steps_per_dispatch": int(steps_per_dispatch),
+                      "batch_size": train_loader.batch_size,
+                      "steps_per_epoch": steps_per_epoch})
+        owns_tel = True
     state, tx, mask = create_train_state(cfg, params, steps_per_epoch,
                                    begin_epoch=begin_epoch,
                                    fixed_prefixes=fixed_prefixes)
@@ -220,6 +245,20 @@ def fit(cfg: Config, model, params, train_loader,
 
         profile_dir = os.path.join(profile_dir,
                                    f"rank{jax.process_index()}")
+    # recompile tracking: jit caches one program per (step fn, bucket
+    # shape), so the first dispatch of each pair is the compile.  The set
+    # mirrors that cache (fit builds fresh step fns, so per-fit is exact)
+    # and makes mixed-bucket epochs show their true compile cost in the
+    # telemetry stream instead of as unexplained slow steps.
+    seen_programs = set()
+
+    def note_dispatch(fn_kind, shape):
+        pkey = (fn_kind, tuple(shape))
+        if pkey not in seen_programs:
+            seen_programs.add(pkey)
+            tel.counter("train/recompile")
+            tel.meta("recompile", program=fn_kind, shape=list(shape))
+
     for epoch in range(begin_epoch, end_epoch):
         bank.reset()
         speedo.reset()
@@ -229,7 +268,21 @@ def fit(cfg: Config, model, params, train_loader,
         # advances this by k; profiling and metric cadence count batches)
         last_fetch = 0
         start_at = min(3, steps_per_epoch - 1)
-        for item in train_loader:
+        # epoch wall-time breakdown, telemetry-or-not (the epoch-end log
+        # line reports wall/loader-wait either way; two perf_counter reads
+        # per item is noise next to a dispatch)
+        ep_t0 = time.perf_counter()
+        loader_wait_s = 0.0
+        it = iter(train_loader)
+        while True:
+            t_wait = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            dt_wait = time.perf_counter() - t_wait
+            loader_wait_s += dt_wait
+            tel.add("train/loader_wait", dt_wait)
             if profile_dir and epoch == begin_epoch and not profiled:
                 if not profiling and consumed >= start_at:
                     jax.profiler.start_trace(profile_dir)
@@ -240,6 +293,7 @@ def fit(cfg: Config, model, params, train_loader,
                     profiling = False
                     profiled = True
                     logger.info("wrote device trace to %s", profile_dir)
+            t_disp = time.perf_counter()
             key, sub = jax.random.split(key)
             n_b = 1
             if loader_wraps:
@@ -247,11 +301,13 @@ def fit(cfg: Config, model, params, train_loader,
                 # items arrive tagged, already stacked AND on device —
                 # the transfer overlapped the previous step's compute
                 kind, n_b, data = item
+                note_dispatch(kind, data["images"].shape)
                 state, metrics = (multi_fn if kind == "group"
                                   else step_fn)(state, data, sub)
                 pending = metrics
             elif multi_fn is None:
                 batch = item
+                note_dispatch("single", batch["images"].shape)
                 if plan is not None and not loader_puts:
                     batch = shard_batch(plan, batch)
                 state, metrics = step_fn(state, batch, sub)
@@ -266,6 +322,7 @@ def fit(cfg: Config, model, params, train_loader,
                 if buf and buf[0]["images"].shape != batch["images"].shape:
                     for b in buf:
                         key, sub = jax.random.split(key)
+                        note_dispatch("single", b["images"].shape)
                         if plan is not None:
                             b = shard_batch(plan, b)
                         state, metrics = step_fn(state, b, sub)
@@ -274,17 +331,20 @@ def fit(cfg: Config, model, params, train_loader,
                 buf.append(batch)
                 if len(buf) == k:
                     stacked = jax.tree.map(lambda *xs: np.stack(xs), *buf)
+                    note_dispatch("group", stacked["images"].shape)
                     stacked = (shard_stacked_batch(plan, stacked)
                                if plan is not None
                                else jax.device_put(stacked))
                     state, metrics = multi_fn(state, stacked, sub)
                     pending = metrics
                     buf = []
+            tel.add("train/dispatch", time.perf_counter() - t_disp, n=n_b)
             # fetch metrics only at Speedometer cadence: a device→host scalar
             # read stalls the dispatch pipeline (and on tunneled devices costs
             # far more than a step), so per-step reads would serialize training
             if consumed + n_b - last_fetch >= frequent and pending is not None:
-                bank.update(jax.device_get(pending))
+                with tel.span("train/fetch_stall"):
+                    bank.update(jax.device_get(pending))
                 pending = None
                 last_fetch = consumed + n_b
             for j in range(n_b):
@@ -293,12 +353,16 @@ def fit(cfg: Config, model, params, train_loader,
         if buf:  # epoch remainder (< k) — flushed AFTER the loop so the
             # drain cannot depend on steps_per_epoch matching the
             # iterator's true yield count (wrapper loaders may differ)
+            t_disp = time.perf_counter()
             for b in buf:
                 key, sub = jax.random.split(key)
+                note_dispatch("single", b["images"].shape)
                 if plan is not None:
                     b = shard_batch(plan, b)
                 state, metrics = step_fn(state, b, sub)
             pending = metrics
+            tel.add("train/dispatch", time.perf_counter() - t_disp,
+                    n=len(buf))
             buf = []
         if profiling:  # epoch shorter than the stop step: close the trace
             jax.block_until_ready(pending)
@@ -306,10 +370,18 @@ def fit(cfg: Config, model, params, train_loader,
             profiling = False
             logger.info("wrote device trace to %s", profile_dir)
         if pending is not None:
-            bank.update(jax.device_get(pending))
+            with tel.span("train/fetch_stall"):
+                bank.update(jax.device_get(pending))
+        ep_wall = time.perf_counter() - ep_t0
+        tel.add("train/epoch", ep_wall)
+        tel.counter("train/steps", consumed)
         if proc0:
-            logger.info("Epoch[%d] Train-%s", epoch,
-                        bank.format().replace("\t", " Train-"))
+            # wall + loader-wait on the one-line epoch summary: single-log
+            # triage of "slow epoch — device or input pipeline?" without
+            # opening the JSONL
+            logger.info("Epoch[%d] Train-%s\tWall=%.1fs LoaderWait=%.1fs",
+                        epoch, bank.format().replace("\t", " Train-"),
+                        ep_wall, loader_wait_s)
         if ckpt is not None:
             # multi-host: EVERY rank calls save — orbax's CheckpointManager
             # runs its own cross-process barriers inside save() and writes
@@ -317,9 +389,10 @@ def fit(cfg: Config, model, params, train_loader,
             # shared filesystem).  Gating this on rank 0 deadlocks orbax's
             # sync_global_devices (found by the two-process CLI drive).
             # State leaves are replicated (DP) so device_get is local.
-            ckpt.save_epoch(epoch + 1, state.params, cfg,
-                            opt_state=state.opt_state,
-                            step=int(jax.device_get(state.step)))
+            with tel.span("train/checkpoint_save"):
+                ckpt.save_epoch(epoch + 1, state.params, cfg,
+                                opt_state=state.opt_state,
+                                step=int(jax.device_get(state.step)))
     if jax.process_count() > 1:
         # align ranks before returning: after the last collective nothing
         # else synchronizes them, and a rank that exits the process much
@@ -328,4 +401,12 @@ def fit(cfg: Config, model, params, train_loader,
         from mx_rcnn_tpu.parallel.distributed import sync
 
         sync("fit_end")
+    if owns_tel:
+        # every rank streams its own event file; only process 0 writes the
+        # aggregated summary (the profile_dir rank-split contract) — the
+        # cross-rank fold is scripts/telemetry_report.py's job
+        if proc0:
+            path = tel.write_summary()
+            logger.info("wrote telemetry summary to %s", path)
+        telemetry.shutdown()
     return state

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Telemetry smoke: 2-step synthetic train with --telemetry-dir on, then fold
+# the JSONL stream into the human table and BENCH-compatible rows.
+set -e
+dir=${TELEMETRY_DIR:-/tmp/mxr_telemetry_smoke}
+rm -rf "$dir"
+python train_end2end.py --network resnet50 --synthetic --synthetic_images 8 \
+  --prefix /tmp/mxr_tel_smoke_ckpt --end_epoch 1 --num-steps 2 --frequent 1 \
+  --telemetry-dir "$dir" "$@"
+test -f "$dir/events_rank0.jsonl"
+test -f "$dir/summary.json"
+python scripts/telemetry_report.py "$dir"
+python scripts/telemetry_report.py "$dir" --bench

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Fold telemetry JSONL event streams into the human table and a
+BENCH_*.json-compatible summary.
+
+  python scripts/telemetry_report.py RUN_DIR              # all ranks' files
+  python scripts/telemetry_report.py a/events_rank0.jsonl b/events_rank0.jsonl
+  python scripts/telemetry_report.py RUN_DIR --json agg.json   # aggregate out
+  python scripts/telemetry_report.py RUN_DIR --bench           # metric rows
+
+Accepts any mix of run directories (expanded to every events_rank*.jsonl
+inside — the multi-host layout) and explicit event files; multiple runs
+fold into one aggregate, which is how the bench trajectory accumulates
+across sessions.  Pure host-side JSON folding: no jax import, safe on a
+machine with no accelerator.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mx_rcnn_tpu.telemetry.report import (aggregate, bench_rows, load_events,
+                                          render_table)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="+",
+                    help="run directories and/or events_rank*.jsonl files")
+    ap.add_argument("--json", default="",
+                    help="also write the aggregated summary JSON here")
+    ap.add_argument("--bench", action="store_true",
+                    help="print one BENCH-compatible JSON line per rate "
+                         "gauge instead of the table")
+    args = ap.parse_args()
+
+    summary = aggregate(load_events(args.paths))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+    if args.bench:
+        for row in bench_rows(summary):
+            print(json.dumps(row))
+    else:
+        print(render_table(summary))
+
+
+if __name__ == "__main__":
+    main()

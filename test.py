@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import argparse
 
+from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.data import TestLoader
 from mx_rcnn_tpu.eval import Predictor, pred_eval
 from mx_rcnn_tpu.logger import logger
@@ -48,10 +49,20 @@ def test_rcnn(args):
             f"--batch_images {bs} must divide by the mesh's data dimension "
             f"{n_data} (the flag is GLOBAL images per step, like train)")
     predictor = Predictor(model, params, cfg, plan=plan)
+    if getattr(args, "telemetry_dir", ""):
+        # eval is single-process (Predictor enforces it), so rank 0 / world
+        # 1 and the summary always belongs to this process
+        telemetry.configure(args.telemetry_dir,
+                            run_meta={"driver": "test", "network": args.network,
+                                      "batch_size": bs})
     loader = TestLoader(roidb, cfg, batch_size=bs)
     stats = pred_eval(predictor, loader, imdb, thresh=args.thresh,
                       vis=args.vis, with_masks=cfg.network.HAS_MASK,
                       det_cache=args.dets_cache or None)
+    if getattr(args, "telemetry_dir", ""):
+        path = telemetry.get().write_summary()
+        logger.info("wrote telemetry summary to %s", path)
+        telemetry.shutdown()
 
     def flat(d, prefix=""):
         out = {}

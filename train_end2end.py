@@ -73,6 +73,7 @@ def train_net(args):
                 seed=getattr(args, "seed", 0),
                 frequent=args.frequent, resume=args.resume,
                 profile_dir=getattr(args, "profile", "") or None,
+                telemetry_dir=getattr(args, "telemetry_dir", "") or None,
                 steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
                 fixed_prefixes=cfg.network.FIXED_PARAMS)
     return state
