@@ -238,6 +238,11 @@ class TPUConfig:
     ROI_MODE: str = "avg"
     # host→device prefetch depth
     PREFETCH: int = 2
+    # consumer-side watchdog on the prefetch queue: no producer heartbeat
+    # for this long raises a diagnostic naming the producer state instead
+    # of the training loop hanging forever on a stuck filesystem read
+    # (<= 0 disables)
+    PREFETCH_WATCHDOG_S: float = 600.0
     # rematerialize the backbone stages in the backward pass
     # (nn.remat on each ResNetStage): trades recompute FLOPs for HBM
     # traffic — the B>=16 lever for the measured relu-backward

@@ -28,6 +28,14 @@ from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.image import (get_image, resize_to_bucket,
                                     space_to_depth2, transform_image)
+from mx_rcnn_tpu.logger import logger
+
+# Fault isolation (train loaders): one missing/corrupt image substitutes a
+# deterministic neighbor record instead of killing the producer thread, but
+# this many failures IN A ROW means the breakage is systemic (unmounted
+# filesystem, wrong dataset path) and must raise, not silently retrain on
+# substitutes.  Class-level so tests/operators can widen it.
+MAX_CONSECUTIVE_BAD_RECORDS = 8
 
 
 def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
@@ -70,6 +78,48 @@ def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
     return out
 
 
+def _load_record_isolated(roidb: list, i: int, cfg: Config,
+                          scale: Tuple[int, int], with_masks: bool = False,
+                          state: Optional[list] = None) -> Tuple[int, dict]:
+    """``_load_record`` with fault isolation for TRAIN loaders: a failing
+    record (missing/corrupt image) substitutes the next roidb record
+    deterministically instead of killing the producer thread, bumping the
+    ``loader/bad_record`` telemetry counter per failure.
+
+    ``state`` is a single-element mutable list holding the CONSECUTIVE
+    failure count across calls from one producer generator — it resets on
+    every success, and crossing ``MAX_CONSECUTIVE_BAD_RECORDS`` raises
+    (systemic breakage must not silently train on substitutes).
+
+    Returns ``(actual_index, sample)`` so callers that pair the sample
+    with other per-record data (ROIIter's proposals) stay consistent
+    with the substituted record.  Eval loaders stay strict: a bad record
+    in evaluation silently changes the metric and must raise.
+    """
+    n = len(roidb)
+    state = state if state is not None else [0]
+    attempt = 0
+    while True:
+        j = (i + attempt) % n
+        try:
+            out = _load_record(roidb[j], cfg, scale, with_masks=with_masks)
+            state[0] = 0
+            return j, out
+        except Exception as e:  # noqa: BLE001 — isolate, count, bound
+            state[0] += 1
+            telemetry.get().counter("loader/bad_record")
+            if state[0] >= MAX_CONSECUTIVE_BAD_RECORDS:
+                raise RuntimeError(
+                    f"{state[0]} consecutive roidb records failed to load "
+                    f"(last: index {j}, {type(e).__name__}: {e}) — this "
+                    f"looks systemic (wrong dataset path? unmounted "
+                    f"filesystem?), not a stray corrupt image") from e
+            logger.warning("bad roidb record %d (%s: %s) — substituting "
+                           "record %d [loader/bad_record]",
+                           j, type(e).__name__, e, (j + 1) % n)
+            attempt += 1
+
+
 def _stack(samples: List[dict]) -> Dict[str, np.ndarray]:
     return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
 
@@ -95,21 +145,32 @@ class _Prefetcher:
     device transfer when double-buffering) and ``loader/queue_full_wait``
     (producer blocked on a full queue = consumer is the bottleneck);
     consumer-side ``loader/queue_depth`` gauge sampled at every get (a
-    persistently empty queue = producer is the bottleneck)."""
+    persistently empty queue = producer is the bottleneck).
 
-    def __init__(self, gen, depth: int, put=None):
+    ``watchdog_s``: consumer-side timeout on the blocking get — a producer
+    stuck past it (hung filesystem read, deadlocked ``put`` hook) raises a
+    diagnostic naming the producer state instead of hanging the training
+    loop forever.  The timeout is measured from the producer's last
+    HEARTBEAT (one per queued batch), so a slow-but-advancing producer is
+    never killed.  <= 0 disables."""
+
+    def __init__(self, gen, depth: int, put=None, watchdog_s: float = 600.0):
         self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._err = None
         self._stop = threading.Event()
         self._tel = telemetry.get()
+        self._watchdog_s = watchdog_s
+        self._beat = time.monotonic()
 
         def enqueue(item) -> bool:
             """Blocking put that honors close(); False once stopped."""
             while not self._stop.is_set():
                 try:
                     self._q.put(item, timeout=0.2)
+                    self._beat = time.monotonic()
                     return True
                 except queue.Full:
+                    self._beat = time.monotonic()  # blocked-on-full is alive
                     continue
             return False
 
@@ -151,11 +212,48 @@ class _Prefetcher:
         self._t = threading.Thread(target=run, daemon=True)
         self._t.start()
 
-    def close(self):
+    def close(self, timeout: float = 5.0):
+        """Stop the producer AND join its thread (bounded) — repeated
+        ``fit()`` calls over one loader must not accumulate daemon threads
+        parked in ``enqueue``.  Draining the queue first unblocks a
+        producer waiting on a full queue so the join is fast."""
         self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._t.join(timeout=timeout)
+        if self._t.is_alive():
+            logger.warning("prefetch producer thread did not exit within "
+                           "%.1fs of close() — still parked in the source "
+                           "generator?", timeout)
 
     def __del__(self):
-        self._stop.set()
+        self._stop.set()  # no join in GC: finalizers must not block
+
+    def _get(self):
+        """Blocking get with the producer watchdog (see class docstring)."""
+        if self._watchdog_s <= 0:
+            return self._q.get()
+        poll = min(self._watchdog_s, 5.0)
+        while True:
+            try:
+                return self._q.get(timeout=poll)
+            except queue.Empty:
+                age = time.monotonic() - self._beat
+                if age < self._watchdog_s and self._t.is_alive():
+                    continue  # slow but advancing (or just started)
+                raise RuntimeError(
+                    f"prefetch queue empty with no producer heartbeat for "
+                    f"{age:.0f}s (watchdog {self._watchdog_s:.0f}s) — "
+                    f"producer thread "
+                    f"{'alive' if self._t.is_alive() else 'DEAD'}, "
+                    f"stop_requested={self._stop.is_set()}, "
+                    f"qsize={self._q.qsize()}: the producer is stuck (hung "
+                    f"filesystem read? deadlocked put hook?) or died "
+                    f"without delivering its end-of-epoch sentinel") \
+                    from None
 
     def __iter__(self):
         tel = self._tel
@@ -165,14 +263,14 @@ class _Prefetcher:
                     # sampled BEFORE the blocking get: a persistently-zero
                     # depth means the consumer outruns the producer
                     tel.gauge("loader/queue_depth", self._q.qsize())
-                item = self._q.get()
+                item = self._get()
                 if item is None:
                     if self._err is not None:
                         raise self._err
                     return
                 yield item
         finally:
-            self._stop.set()
+            self.close()
 
 
 class AnchorLoader:
@@ -223,6 +321,7 @@ class AnchorLoader:
         # stacking shipped each group synchronously)
         self.wrap = None
         self._rng = np.random.RandomState(seed)
+        self._skip = 0  # one-shot batch skip armed by skip_next()
         # aspect grouping: horizontal (w>=h) vs vertical image index pools
         self._groups = [
             [i for i, r in enumerate(roidb) if r["width"] >= r["height"]],
@@ -281,23 +380,58 @@ class AnchorLoader:
             chosen = [scales[0]] * len(batches)
         return list(zip(batches, chosen))
 
+    # -- deterministic fast-forward (fit(auto_resume) mid-epoch resume) ---
+
+    def advance_epochs(self, n: int) -> None:
+        """Draw-and-discard ``n`` epoch plans, advancing the shared
+        RandomState exactly as ``n`` real iterations would — epoch k's
+        plan depends on the k prior epochs' draws, so resuming at epoch k
+        must burn the first k plans to reproduce the original schedule."""
+        for _ in range(n):
+            self._epoch_plan()
+
+    def skip_next(self, n: int) -> None:
+        """Arm a one-shot skip: the NEXT iteration drops its first ``n``
+        batches (consumed before the interruption).  The full plan is
+        still generated first — RNG draws are position-dependent, so the
+        tail batches come out identical to the uninterrupted epoch."""
+        if n < 0:
+            raise ValueError(f"skip_next: n must be >= 0, got {n}")
+        self._skip = n
+
+    def _take_epoch_plan(self) -> List[Tuple[np.ndarray, Tuple[int, int]]]:
+        """One epoch's plan with any armed skip applied (and disarmed)."""
+        plan = self._epoch_plan()  # full draw FIRST: keeps RNG in sequence
+        skip, self._skip = self._skip, 0
+        if skip:
+            if skip > len(plan):
+                raise ValueError(
+                    f"skip_next({skip}) exceeds the epoch's {len(plan)} "
+                    f"batches — resume position does not match this "
+                    f"loader's schedule (different seed or batch size?)")
+            plan = plan[skip:]
+        return plan
+
     def _part(self, chunk: np.ndarray) -> np.ndarray:
         """This process's contiguous row slice of a global batch."""
         bl = self.batch_size // self.num_parts
         return chunk[self.part_index * bl:(self.part_index + 1) * bl]
 
     def _produce(self, plan) -> Iterator[Dict[str, np.ndarray]]:
+        fail_state = [0]  # consecutive bad records, across the whole epoch
         for chunk, scale in plan:
-            yield _stack([_load_record(self.roidb[i], self.cfg, scale,
-                                       with_masks=True)
+            yield _stack([_load_record_isolated(self.roidb, int(i), self.cfg,
+                                                scale, with_masks=True,
+                                                state=fail_state)[1]
                           for i in self._part(chunk)])
 
     def __iter__(self):
-        plan = self._epoch_plan()  # RNG on the consumer thread only
+        plan = self._take_epoch_plan()  # RNG on the consumer thread only
         gen = self._produce(plan)
         if self.wrap is not None:
             gen = self.wrap(gen)
-        return iter(_Prefetcher(gen, self.cfg.tpu.PREFETCH, put=self.put))
+        return iter(_Prefetcher(gen, self.cfg.tpu.PREFETCH, put=self.put,
+                                watchdog_s=self.cfg.tpu.PREFETCH_WATCHDOG_S))
 
 
 class TestLoader:
@@ -335,8 +469,11 @@ class TestLoader:
                 batch["batch_valid"] = np.asarray([True] * len(idx) + [False] * pad)
                 yield batch
 
-        return iter(_Prefetcher(produce(), self.cfg.tpu.PREFETCH,
-                                put=self.put))
+        # strict loads by design (no fault isolation): a silently
+        # substituted record would corrupt the eval metric
+        return iter(_Prefetcher(
+            produce(), self.cfg.tpu.PREFETCH, put=self.put,
+            watchdog_s=self.cfg.tpu.PREFETCH_WATCHDOG_S))
 
 
 class ROIIter:
@@ -376,20 +513,32 @@ class ROIIter:
     def steps_per_epoch(self) -> int:
         return len(self._inner)
 
+    def advance_epochs(self, n: int) -> None:
+        self._inner.advance_epochs(n)
+
+    def skip_next(self, n: int) -> None:
+        self._inner.skip_next(n)
+
     def __iter__(self):
         cfg = self.cfg
         p_max = cfg.TRAIN.RPN_POST_NMS_TOP_N
         # same per-batch scale-bucket plan as AnchorLoader (upstream samples
         # TRAIN.SCALES in the Fast-RCNN path too); proposals are in the
         # original image frame and rescale by each batch's own im_scale
-        plan = self._inner._epoch_plan()
+        plan = self._inner._take_epoch_plan()
+        roidb = self._inner.roidb
 
         def produce():
+            fail_state = [0]
             for chunk, scale in plan:
                 samples = []
                 for i in self._inner._part(chunk):
-                    rec = self._inner.roidb[i]
-                    s = _load_record(rec, cfg, scale)
+                    # the substituted index pairs the sample with ITS OWN
+                    # proposals — mixing record j's pixels with record i's
+                    # rois would train on garbage
+                    j, s = _load_record_isolated(roidb, int(i), cfg, scale,
+                                                 state=fail_state)
+                    rec = roidb[j]
                     props = np.asarray(rec.get("proposals",
                                                np.zeros((0, 4))), np.float32)
                     rois = np.zeros((p_max, 4), np.float32)
@@ -406,4 +555,5 @@ class ROIIter:
         gen = produce()
         if self.wrap is not None:
             gen = self.wrap(gen)
-        return iter(_Prefetcher(gen, cfg.tpu.PREFETCH, put=self.put))
+        return iter(_Prefetcher(gen, cfg.tpu.PREFETCH, put=self.put,
+                                watchdog_s=cfg.tpu.PREFETCH_WATCHDOG_S))

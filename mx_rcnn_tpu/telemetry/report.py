@@ -18,6 +18,19 @@ from typing import Iterable, List
 
 from mx_rcnn_tpu.telemetry.sink import SCHEMA_VERSION
 
+# the fault-tolerance subsystem's recovery events (train/resilience.py):
+# rendered as their own table section — zeros included — so "did the run
+# recover from anything?" is answerable at a glance (and greppable by
+# script/fault_smoke.sh) without knowing which counters might exist
+RECOVERY_COUNTERS = (
+    "loader/bad_record",
+    "train/nan_detected",
+    "train/nan_skipped",
+    "train/nan_rollback",
+    "train/preempted",
+    "checkpoint/retry",
+)
+
 
 def event_files(paths: Iterable[str]) -> List[str]:
     """Expand run dirs to their per-rank event files; pass files through."""
@@ -128,7 +141,13 @@ def render_table(summary: dict) -> str:
         lines.append("")
         lines.append(f"{'counter':<34}{'total':>8}")
         for name, v in counters.items():
+            if name in RECOVERY_COUNTERS:
+                continue  # recovery events get their own section below
             lines.append(f"{name:<34}{v:>8}")
+        lines.append("")
+        lines.append(f"{'recovery event':<34}{'total':>8}")
+        for name in RECOVERY_COUNTERS:
+            lines.append(f"{name:<34}{counters.get(name, 0):>8}")
     gauges = summary.get("gauges", {})
     if gauges:
         lines.append("")

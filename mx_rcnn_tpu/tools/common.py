@@ -20,6 +20,8 @@ from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model, init_params
 from mx_rcnn_tpu.parallel import MeshPlan, make_mesh
 from mx_rcnn_tpu.train.checkpoint import load_params_npz
+from mx_rcnn_tpu.train.resilience import (add_resilience_args,
+                                          inject_roidb_faults)
 
 
 def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
@@ -92,6 +94,9 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
                                  "a loop body — see train/trainer.py fit "
                                  "docstring; applies to every fit-based "
                                  "driver, alternate stages included)")
+        # fault tolerance (train/resilience.py): --save-every-n-steps,
+        # --auto-resume, --nan-policy on every fit-based driver
+        add_resilience_args(parser)
     else:
         parser.add_argument("--epoch", type=int, default=10,
                             help="checkpoint epoch to load")
@@ -178,7 +183,10 @@ def get_train_roidb(imdb, cfg: Config, roidb=None):
         roidb = imdb.gt_roidb()
     if cfg.TRAIN.FLIP:
         roidb = imdb.append_flipped_images(roidb)
-    return imdb.filter_roidb(roidb)
+    # env-driven fault injection (MXR_FAULT_BAD_RECORD; no-op when unset)
+    # AFTER filtering: the corrupted record must survive into the epoch
+    # plan for script/fault_smoke.sh to exercise the loader's isolation
+    return inject_roidb_faults(imdb.filter_roidb(roidb))
 
 
 def init_dist_from_args(args) -> tuple:
@@ -265,11 +273,18 @@ def _overlay(params, loaded):
 
 
 class CappedLoader:
-    """Wraps a loader to at most ``n`` steps per epoch (smoke runs)."""
+    """Wraps a loader to at most ``n`` steps per epoch (smoke runs).
+
+    Forwards the resilience fast-forward API (``advance_epochs`` /
+    ``skip_next``) so ``--num-steps`` smoke runs still auto-resume: a
+    skip of ``m`` consumed batches shrinks THIS wrapper's next epoch to
+    ``n - m`` yields, keeping the epoch end at the same global position
+    the uninterrupted capped run would have reached."""
 
     def __init__(self, inner, n: int):
         self._inner = inner
         self._n = n
+        self._skip = 0
         self.batch_size = inner.batch_size
 
     @property
@@ -279,10 +294,19 @@ class CappedLoader:
     def __len__(self):
         return self.steps_per_epoch
 
+    def advance_epochs(self, n: int) -> None:
+        self._inner.advance_epochs(n)
+
+    def skip_next(self, m: int) -> None:
+        self._inner.skip_next(m)
+        self._skip = m
+
     def __iter__(self):
+        skip, self._skip = self._skip, 0
+        budget = max(self.steps_per_epoch - skip, 0)
         it = iter(self._inner)
         for i, batch in enumerate(it):
-            if i >= self._n:
+            if i >= budget:
                 close = getattr(it, "close", None)
                 if close:
                     close()

@@ -13,7 +13,7 @@ from mx_rcnn_tpu.tools.common import (CappedLoader, add_common_args,
                                       check_dist_loader, config_from_args,
                                       get_imdb, get_train_roidb,
                                       init_or_load_params, setup_parallel)
-from mx_rcnn_tpu.train import fit
+from mx_rcnn_tpu.train import ResilienceOptions, fit
 
 
 def train_rcnn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
@@ -62,7 +62,8 @@ def train_rcnn(args, cfg=None, params=None, roidb=None, frozen_shared=False):
                 seed=getattr(args, "seed", 0),
                 frequent=args.frequent, fixed_prefixes=fixed,
                 telemetry_dir=getattr(args, "telemetry_dir", "") or None,
-                steps_per_dispatch=getattr(args, "steps_per_dispatch", 1))
+                steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
+                resilience=ResilienceOptions.from_args(args))
     return state
 
 

@@ -10,4 +10,7 @@ from mx_rcnn_tpu.train.callback import Speedometer
 from mx_rcnn_tpu.train.train_step import (TrainState, create_train_state,
                                           make_multi_train_step,
                                           make_train_step)
+from mx_rcnn_tpu.train.resilience import (NonFiniteLossError,
+                                          PreemptionGuard, ResilienceOptions,
+                                          add_resilience_args, retry_io)
 from mx_rcnn_tpu.train.trainer import fit

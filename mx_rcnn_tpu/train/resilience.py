@@ -1,0 +1,298 @@
+"""Fault tolerance — step-level checkpoint/auto-resume, NaN sentinel
+policies, preemption handling, and retrying checkpoint I/O.
+
+SURVEY §5 marks failure handling as absent in the reference ("crash =
+rerun from the last epoch checkpoint via ``--resume``); the ROADMAP
+north-star — multi-hour COCO runs on preemptible fleets — needs a run to
+survive preemption, a corrupt image, a transient filesystem error, or a
+NaN spike without losing the epoch.  This module holds the pieces the
+trainer/checkpoint/loader layers wire together:
+
+* :class:`ResilienceOptions` — the knob bundle every train driver exposes
+  (``--save-every-n-steps``, ``--auto-resume``, ``--nan-policy``).
+* :class:`PreemptionGuard` — SIGTERM/SIGINT → "save at the next step
+  boundary and exit cleanly" (the handler only sets a flag; ``fit`` does
+  the save where the state is consistent).  A second signal falls back to
+  the default handler so a stuck save can still be killed.
+* :func:`retry_io` — exponential-backoff retry for transient checkpoint
+  I/O errors (``checkpoint/retry`` telemetry counter).
+* NaN policies (:data:`NAN_POLICIES`): ``halt`` (diagnostic dump +
+  :class:`NonFiniteLossError`), ``skip`` (the step itself discards
+  non-finite updates in-graph — params are never poisoned), ``rollback``
+  (restore the last good step checkpoint and keep consuming the loader).
+* Env-driven fault injection (``MXR_FAULT_BAD_RECORD``,
+  ``MXR_FAULT_NAN_STEP``) so ``script/fault_smoke.sh`` can exercise the
+  recovery paths through the real CLI drivers; the richer in-process
+  harness lives in ``tests/faults.py``.
+
+Every recovery event lands in the telemetry stream
+(``train/nan_detected``, ``train/nan_rollback``, ``loader/bad_record``,
+``checkpoint/retry``, ``train/preempted``) so PR-1's report can triage
+recoveries the same way it triages slow steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import threading
+import time
+from typing import Optional, Tuple
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.logger import logger
+
+NAN_POLICIES = ("off", "halt", "skip", "rollback")
+
+# (epoch, consumed) → one orbax int key; an epoch cannot run more batches
+# than this (guarded at save).  The decoded pair is the resume position:
+# "epoch E, C loader batches already dispatched".
+STEP_KEY_STRIDE = 10 ** 7
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised by the ``halt`` NaN policy (and by ``rollback`` when there is
+    no step checkpoint to roll back to)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceOptions:
+    """Fault-tolerance knobs for ``fit`` (all off by default — a plain
+    ``fit`` call compiles the exact same step program as before).
+
+    ``save_every_n_steps``: mid-epoch step checkpoints at this cadence
+    (0 = epoch checkpoints only).  ``auto_resume``: pick the latest
+    checkpoint — step or epoch — under the prefix and continue from it,
+    fast-forwarding the loader (no manual ``--begin_epoch``/``--resume``).
+    ``nan_policy``: what to do when the in-step all-finite sentinel trips
+    (see :data:`NAN_POLICIES`).  ``max_io_retries``/``io_backoff_s``:
+    transient checkpoint-I/O retry budget.
+    """
+
+    save_every_n_steps: int = 0
+    auto_resume: bool = False
+    nan_policy: str = "off"
+    max_io_retries: int = 3
+    io_backoff_s: float = 0.5
+
+    def __post_init__(self):
+        if self.nan_policy not in NAN_POLICIES:
+            raise ValueError(f"nan_policy must be one of {NAN_POLICIES}, "
+                             f"got {self.nan_policy!r}")
+        if self.save_every_n_steps < 0:
+            raise ValueError("save_every_n_steps must be >= 0")
+
+    @property
+    def enabled(self) -> bool:
+        return (self.save_every_n_steps > 0 or self.auto_resume
+                or self.nan_policy != "off")
+
+    @property
+    def sentinel(self) -> bool:
+        """The step must compute the on-device all-finite flag."""
+        return self.nan_policy != "off"
+
+    @property
+    def skip_nonfinite(self) -> bool:
+        """The step must discard non-finite updates in-graph (``skip``
+        policy: params are protected before the host ever notices)."""
+        return self.nan_policy == "skip"
+
+    @classmethod
+    def from_args(cls, args) -> "ResilienceOptions":
+        """Build from a train driver's parsed argv (missing attributes —
+        e.g. train_alternate's stage calls — default to off)."""
+        return cls(
+            save_every_n_steps=getattr(args, "save_every_n_steps", 0) or 0,
+            auto_resume=getattr(args, "auto_resume", False),
+            nan_policy=getattr(args, "nan_policy", "off") or "off",
+        )
+
+
+def add_resilience_args(parser) -> None:
+    """The shared ``--save-every-n-steps/--auto-resume/--nan-policy``
+    argparse surface (every fit-based train driver gets these through
+    ``tools.common.add_common_args``)."""
+    parser.add_argument("--save-every-n-steps", type=int, default=0,
+                        dest="save_every_n_steps",
+                        help="mid-epoch step checkpoints every N steps "
+                             "(atomic orbax writes under PREFIX/steps, "
+                             "rolling window; 0 = epoch checkpoints only)")
+    parser.add_argument("--auto-resume", action="store_true",
+                        dest="auto_resume",
+                        help="resume from the latest checkpoint (step or "
+                             "epoch) under --prefix, fast-forwarding the "
+                             "loader to the exact batch; fresh start when "
+                             "none exists — safe to pass always")
+    parser.add_argument("--nan-policy", default="off", dest="nan_policy",
+                        choices=list(NAN_POLICIES),
+                        help="non-finite loss/grad handling: halt = "
+                             "diagnostic dump + error; skip = drop the bad "
+                             "update in-graph and keep going; rollback = "
+                             "restore the last good step checkpoint")
+
+
+# -- step-checkpoint keying ------------------------------------------------
+
+def encode_step_key(epoch: int, consumed: int) -> int:
+    """(epoch, consumed loader batches) → the orbax int step key."""
+    if not 0 <= consumed < STEP_KEY_STRIDE:
+        raise ValueError(f"consumed {consumed} outside [0, {STEP_KEY_STRIDE})")
+    return epoch * STEP_KEY_STRIDE + consumed
+
+
+def decode_step_key(key: int) -> Tuple[int, int]:
+    return key // STEP_KEY_STRIDE, key % STEP_KEY_STRIDE
+
+
+# -- transient-I/O retry ---------------------------------------------------
+
+def retry_io(fn, what: str, retries: int = 3, backoff_s: float = 0.5,
+             exceptions=(OSError, TimeoutError)):
+    """Run ``fn()`` retrying transient errors with exponential backoff.
+
+    Each retry bumps the ``checkpoint/retry`` telemetry counter and logs
+    the error; the last failure re-raises.  ``exceptions`` is deliberately
+    narrow (filesystem/timeout) — programming errors must not be retried
+    into silence.
+    """
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except exceptions as e:
+            if attempt == retries:
+                raise
+            delay = backoff_s * (2 ** attempt)
+            telemetry.get().counter("checkpoint/retry")
+            logger.warning("%s failed (%s: %s) — retry %d/%d in %.1fs",
+                           what, type(e).__name__, e, attempt + 1, retries,
+                           delay)
+            time.sleep(delay)
+
+
+# -- preemption ------------------------------------------------------------
+
+class PreemptionGuard:
+    """Context manager turning SIGTERM/SIGINT into a "save at the next
+    step boundary" request.
+
+    The handler only sets a flag — all checkpoint work happens on the
+    training loop's thread, at a step boundary, where the state is
+    consistent and (multi-host) every rank reaches the orbax barriers.
+    A SECOND signal restores the previous handlers and re-raises, so a
+    hung save never makes the process unkillable.  Installing handlers is
+    only legal on the main thread; elsewhere the guard degrades to inert
+    (``requested`` stays False) with a warning.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self._requested = False
+        self._prev = {}
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    def _handler(self, signum, frame):
+        if self._requested:
+            # second signal: the user means it — restore and re-deliver
+            self._restore()
+            signal.raise_signal(signum)
+            return
+        self._requested = True
+        logger.warning("received %s — saving a step checkpoint at the next "
+                       "step boundary, then exiting cleanly (send again to "
+                       "kill immediately)", signal.Signals(signum).name)
+
+    def __enter__(self):
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning("PreemptionGuard outside the main thread: signal "
+                           "handlers not installed, preemption save disabled")
+            return self
+        for s in self.SIGNALS:
+            self._prev[s] = signal.signal(s, self._handler)
+        return self
+
+    def _restore(self):
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev = {}
+
+    def __exit__(self, *exc):
+        self._restore()
+        return False
+
+
+def preemption_agreed(local: bool) -> bool:
+    """Cross-rank OR of the local preemption flag.
+
+    Multi-host SIGTERMs arrive skewed across ranks, and a rank saving
+    alone would deadlock orbax's barriers — so every rank calls this at
+    the SAME loop points (metric-fetch boundaries, which advance in
+    lockstep) and all exit together once any rank was signalled.
+    Single-process: just the local flag, checked every step.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return local
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.asarray(bool(local)))
+    return bool(np.any(flags))
+
+
+# -- NaN diagnostics -------------------------------------------------------
+
+def dump_nan_diagnostics(out_dir: Optional[str], epoch: int, consumed: int,
+                         step: int, scalars: dict) -> Optional[str]:
+    """``halt`` policy's dump: the detection position + the last fetched
+    metric scalars, as JSON next to the run's other artifacts."""
+    if not out_dir:
+        return None
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"nan_dump_e{epoch}_b{consumed}.json")
+    doc = {"epoch": int(epoch), "consumed": int(consumed), "step": int(step),
+           "time": time.time(),
+           "metrics": {k: float(v) for k, v in scalars.items()}}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    return path
+
+
+# -- env-driven fault injection (script/fault_smoke.sh) --------------------
+
+ENV_BAD_RECORD = "MXR_FAULT_BAD_RECORD"
+ENV_NAN_STEP = "MXR_FAULT_NAN_STEP"
+
+
+def inject_roidb_faults(roidb: list) -> list:
+    """Corrupt the roidb records named by ``MXR_FAULT_BAD_RECORD`` (comma
+    indices) so their load raises — the loader's fault isolation must
+    substitute them.  No-op (and zero cost) when the env var is unset;
+    called from ``tools.common.get_train_roidb`` so the injection reaches
+    every CLI train driver without a dedicated flag."""
+    spec = os.environ.get(ENV_BAD_RECORD, "")
+    if not spec:
+        return roidb
+    for tok in spec.split(","):
+        i = int(tok) % max(len(roidb), 1)
+        rec = dict(roidb[i])
+        rec.pop("image_array", None)  # synthetic records ship pixels inline
+        rec["image"] = "/nonexistent/mxr_injected_bad_record.jpg"
+        roidb[i] = rec
+        logger.warning("fault injection: corrupted roidb record %d "
+                       "(%s=%s)", i, ENV_BAD_RECORD, spec)
+    return roidb
+
+
+def nan_injection_step() -> Optional[int]:
+    """Consumed-batch index at which ``fit`` poisons the images with NaN
+    (``MXR_FAULT_NAN_STEP``); None when unset."""
+    spec = os.environ.get(ENV_NAN_STEP, "")
+    return int(spec) if spec else None

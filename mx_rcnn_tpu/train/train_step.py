@@ -82,11 +82,29 @@ def _loss_fn(params, model, batch, key, graph: str):
     return total, aux
 
 
+def _all_finite(total, grads):
+    """On-device scalar: loss AND every gradient leaf finite (the NaN
+    sentinel — one cheap fused reduction per leaf, no host sync)."""
+    flags = [jnp.isfinite(total)]
+    flags += [jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)]
+    return jnp.all(jnp.stack(flags))
+
+
 def _build_step(model, tx: optax.GradientTransformation, graph: str,
-                trainable_mask) -> Callable:
+                trainable_mask, sentinel: bool = False,
+                skip_nonfinite: bool = False) -> Callable:
     """The raw (un-jitted) train step shared by ``make_train_step`` and
     ``make_multi_train_step``: loss+grad, frozen-subtree stop_gradient,
-    optimizer update, metric scalars, step counter."""
+    optimizer update, metric scalars, step counter.
+
+    ``sentinel`` adds an on-device all-finite flag over (loss, grads) to
+    the metrics (``all_finite``) — fetched by the trainer at Speedometer
+    cadence, it drives the NaN policies without a per-step host sync.
+    ``skip_nonfinite`` (the ``skip`` policy) additionally guards the
+    update in-graph: a non-finite step keeps the previous params AND
+    optimizer state (only the step counter advances), so params can never
+    be poisoned in the window before the host notices.
+    """
 
     def step(state: TrainState, batch, key):
         def loss_fn(params):
@@ -103,6 +121,13 @@ def _build_step(model, tx: optax.GradientTransformation, graph: str,
         params = optax.apply_updates(state.params, updates)
         metrics = metric_scalars(aux)
         metrics["total_loss"] = total
+        if sentinel:
+            finite = _all_finite(total, grads)
+            metrics["all_finite"] = finite.astype(jnp.float32)
+            if skip_nonfinite:
+                keep = lambda new, old: jnp.where(finite, new, old)
+                params = jax.tree.map(keep, params, state.params)
+                opt_state = jax.tree.map(keep, opt_state, state.opt_state)
         new_state = TrainState(step=state.step + 1, params=params,
                                opt_state=opt_state)
         return new_state, metrics
@@ -114,7 +139,9 @@ def make_train_step(model, tx: optax.GradientTransformation,
                     plan: Optional[MeshPlan] = None,
                     graph: str = "end2end",
                     donate: bool = True,
-                    trainable_mask=None) -> Callable:
+                    trainable_mask=None,
+                    sentinel: bool = False,
+                    skip_nonfinite: bool = False) -> Callable:
     """Build ``train_step(state, batch, key) -> (state, metrics)``.
 
     With a ``MeshPlan``, inputs/outputs carry NamedShardings (batch split on
@@ -127,13 +154,18 @@ def make_train_step(model, tx: optax.GradientTransformation,
     their gradients are structural zeros and XLA dead-code-eliminates the
     frozen backward tail entirely (the reference freezes conv1+stage1 —
     ``fixed_param_prefix`` — but still computed those gradients; we don't).
+
+    ``sentinel``/``skip_nonfinite``: the NaN sentinel / in-graph
+    non-finite-update guard (see ``_build_step``; driven by
+    ``resilience.ResilienceOptions.nan_policy``).
     """
     if plan is not None:
         # thin-shard guard at the mechanism level: every spatially-sharded
         # step (fit, dryrun, direct callers) compiles through here
         check_spatial(plan, model.cfg)
 
-    step = _build_step(model, tx, graph, trainable_mask)
+    step = _build_step(model, tx, graph, trainable_mask,
+                       sentinel=sentinel, skip_nonfinite=skip_nonfinite)
     if plan is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
     return _jit_planned(step, plan, donate)
@@ -188,7 +220,9 @@ def make_multi_train_step(model, tx: optax.GradientTransformation, k: int,
                           graph: str = "end2end",
                           donate: bool = True,
                           trainable_mask=None,
-                          unroll: Optional[bool] = None) -> Callable:
+                          unroll: Optional[bool] = None,
+                          sentinel: bool = False,
+                          skip_nonfinite: bool = False) -> Callable:
     """``k`` train steps in ONE dispatched program: ``lax.scan`` over
     batches stacked on a leading axis (every leaf shaped (k, ...)).
 
@@ -225,7 +259,8 @@ def make_multi_train_step(model, tx: optax.GradientTransformation, k: int,
         unroll = jax.default_backend() == "cpu"
     if plan is not None:
         check_spatial(plan, model.cfg)
-    step = _build_step(model, tx, graph, trainable_mask)
+    step = _build_step(model, tx, graph, trainable_mask,
+                       sentinel=sentinel, skip_nonfinite=skip_nonfinite)
 
     def multi(state: TrainState, batches, key):
         if k == 1:

@@ -14,6 +14,7 @@ device on the current step's scalars.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Optional
 
@@ -27,9 +28,31 @@ from mx_rcnn_tpu.parallel.mesh import MeshPlan, shard_batch, shard_stacked_batch
 from mx_rcnn_tpu.train.callback import Speedometer
 from mx_rcnn_tpu.train.checkpoint import CheckpointManager
 from mx_rcnn_tpu.train.metric import MetricBank
+from mx_rcnn_tpu.train.resilience import (NonFiniteLossError,
+                                          PreemptionGuard, ResilienceOptions,
+                                          dump_nan_diagnostics,
+                                          nan_injection_step,
+                                          preemption_agreed)
 from mx_rcnn_tpu.train.train_step import (TrainState, create_train_state,
                                           make_multi_train_step,
                                           make_train_step)
+
+
+def _runtime_owned(tree):
+    """Deep-copy restored host (numpy) leaves into runtime-owned device
+    buffers before they reach the donated step function.
+
+    Orbax restores into numpy arrays.  On the CPU backend jax converts a
+    numpy argument zero-copy — the device buffer aliases memory that numpy
+    still owns — and ``donate_argnums`` then lets XLA reuse that aliased
+    input buffer for the step's OUTPUT params.  The moment the restored
+    tree is dropped (the old ``TrainState`` dies at rebind), numpy frees
+    the memory under the live output, which then reads back as heap
+    garbage.  An explicit device copy breaks the alias; every restore path
+    that feeds ``TrainState`` must go through this."""
+    return jax.tree.map(
+        lambda a: jax.numpy.array(a) if isinstance(a, np.ndarray) else a,
+        tree)
 
 
 def _make_group_wrap(k: int, plan: Optional[MeshPlan]):
@@ -70,13 +93,17 @@ def _make_group_wrap(k: int, plan: Optional[MeshPlan]):
     return wrap
 
 
-def _reset_schedule_counts(opt_state):
-    """Zero every ``count`` leaf in an optax state tree."""
+def _reset_schedule_counts(opt_state, value: int = 0):
+    """Set every ``count`` leaf in an optax state tree to ``value`` — the
+    number of optimizer updates already taken against the CURRENT schedule
+    basis: 0 for an epoch-boundary resume (the schedule is rebuilt relative
+    to ``begin_epoch``), ``consumed`` for a mid-epoch step resume (rebuilt
+    relative to that epoch, with ``consumed`` steps already inside it)."""
 
     def reset(path, leaf):
         names = [getattr(e, "name", getattr(e, "key", "")) for e in path]
         if names and names[-1] == "count":
-            return jax.numpy.zeros_like(leaf)
+            return jax.numpy.full_like(leaf, value)
         return leaf
 
     return jax.tree_util.tree_map_with_path(reset, opt_state)
@@ -93,7 +120,8 @@ def fit(cfg: Config, model, params, train_loader,
         profile_dir: Optional[str] = None,
         telemetry_dir: Optional[str] = None,
         steps_per_dispatch: int = 1,
-        fixed_prefixes=None) -> TrainState:
+        fixed_prefixes=None,
+        resilience: Optional[ResilienceOptions] = None) -> TrainState:
     """Train ``model`` from ``params`` over ``train_loader`` epochs.
 
     train_loader: iterable over epochs yielding dict batches (numpy,
@@ -137,6 +165,37 @@ def fit(cfg: Config, model, params, train_loader,
     (keys are fold_in of one dispatch key), and metrics arrive as k-step
     means at dispatch granularity.  Epoch remainders smaller than k run
     through the single-step program.
+
+    ``resilience`` (``ResilienceOptions``; all knobs off by default — a
+    plain call compiles the exact same step program as before):
+
+    * ``save_every_n_steps``: mid-epoch step checkpoints under
+      ``{prefix}/steps`` at that batch cadence (always on a dispatch
+      boundary; under an active NaN sentinel the due save forces a metric
+      fetch first, so a step checkpoint is only ever written from
+      verified-finite state).
+    * ``auto_resume``: pick the furthest checkpoint — step or epoch —
+      under ``prefix`` and continue from it.  Mid-epoch resume is EXACT
+      on seed-deterministic loaders: the loader's RNG is advanced past
+      the completed epochs (``advance_epochs``) and the resumed epoch's
+      plan is generated in full then sliced (``skip_next``), the trainer
+      RNG key is restored from the checkpoint, and the LR schedule counts
+      restart at ``consumed`` against the epoch-rebased schedule — so the
+      tail of the run is batch-for-batch identical to the uninterrupted
+      one (k=1; k>1 regrouping at the resume point may differ around
+      bucket flushes).
+    * ``nan_policy``: the on-device all-finite sentinel is checked at
+      every metric fetch.  ``halt`` dumps diagnostics and raises
+      ``NonFiniteLossError``; ``skip`` counts (the step discarded the
+      non-finite update in-graph, params were never poisoned);
+      ``rollback`` restores the latest step checkpoint in-memory and
+      keeps consuming the loader (the poisoned stretch contributes
+      nothing; schedule counts resume from the checkpoint, so the LR
+      step count lags by the rolled-back stretch — accepted).
+    * SIGTERM/SIGINT during the epoch loop request a save at the next
+      dispatch boundary and a clean return (``train/preempted``); ranks
+      agree via allgather at lockstep fetch boundaries so orbax's save
+      barriers never deadlock.
     """
     # thin-shard guard lives in make_train_step (mechanism level); eval's is
     # in Predictor.__init__ since it never builds a train step
@@ -152,11 +211,40 @@ def fit(cfg: Config, model, params, train_loader,
                       "batch_size": train_loader.batch_size,
                       "steps_per_epoch": steps_per_epoch})
         owns_tel = True
+    res = resilience if resilience is not None else ResilienceOptions()
+    ckpt = (CheckpointManager(prefix, io_retries=res.max_io_retries,
+                              io_backoff_s=res.io_backoff_s)
+            if prefix else None)
+
+    # auto-resume resolves the true starting position BEFORE the train
+    # state exists: the LR schedule's boundaries are built relative to
+    # begin_epoch, so the resolved epoch must feed make_optimizer
+    begin0 = begin_epoch  # caller's begin (= the interrupted run's begin)
+    step_resume = None  # (epoch, consumed) when resuming mid-epoch
+    if res.auto_resume:
+        if ckpt is None:
+            raise ValueError("auto_resume requires a checkpoint prefix")
+        point = ckpt.latest_resume_point()
+        if point is None:
+            logger.info("auto-resume: no checkpoint under %s — fresh start",
+                        prefix)
+        else:
+            kind, r_ep, r_cons = point
+            begin_epoch = r_ep
+            if kind == "epoch":
+                resume = True  # the legacy epoch-resume path below
+                logger.info("auto-resume: epoch checkpoint %d under %s",
+                            r_ep, prefix)
+            else:
+                step_resume = (r_ep, r_cons)
+                logger.info("auto-resume: step checkpoint (epoch %d, "
+                            "batch %d) under %s", r_ep, r_cons, prefix)
+
     state, tx, mask = create_train_state(cfg, params, steps_per_epoch,
                                    begin_epoch=begin_epoch,
                                    fixed_prefixes=fixed_prefixes)
-    ckpt = CheckpointManager(prefix) if prefix else None
 
+    restored_key = None
     if resume:
         if ckpt is None:
             raise ValueError("resume=True requires a checkpoint prefix")
@@ -171,10 +259,33 @@ def fit(cfg: Config, model, params, train_loader,
             # global count would fire every LR drop begin_epoch epochs early.
             r_opt = _reset_schedule_counts(r_opt)
         state = TrainState(step=jax.numpy.asarray(r_step, jax.numpy.int32),
-                           params=r_params,
-                           opt_state=r_opt if r_opt is not None else state.opt_state)
+                           params=_runtime_owned(r_params),
+                           opt_state=(_runtime_owned(r_opt)
+                                      if r_opt is not None
+                                      else state.opt_state))
         logger.info("resumed from %s epoch %d (step %d)", prefix, begin_epoch,
                     r_step)
+    elif step_resume is not None:
+        r_ep, r_cons = step_resume
+        abstract = {"params": jax.device_get(state.params),
+                    "opt_state": jax.device_get(state.opt_state),
+                    "step": 0, "epoch": 0, "consumed": 0,
+                    "rng_key": np.zeros((2,), np.uint32)}
+        payload = ckpt.load_step_checkpoint(r_ep, r_cons,
+                                            abstract_payload=abstract)
+        r_opt = payload.get("opt_state")
+        if r_opt is not None:
+            # schedule rebuilt relative to r_ep; r_cons updates already
+            # happened inside that epoch (see _reset_schedule_counts)
+            r_opt = _reset_schedule_counts(r_opt, value=r_cons)
+        state = TrainState(
+            step=jax.numpy.asarray(payload["step"], jax.numpy.int32),
+            params=_runtime_owned(payload["params"]),
+            opt_state=(_runtime_owned(r_opt) if r_opt is not None
+                       else state.opt_state))
+        restored_key = payload.get("rng_key")
+        logger.info("resumed mid-epoch from %s (epoch %d, batch %d, "
+                    "step %d)", prefix, r_ep, r_cons, int(payload["step"]))
 
     if plan is not None:
         # multi-host: create the mesh's cross-process communicator NOW,
@@ -185,10 +296,14 @@ def fit(cfg: Config, model, params, train_loader,
 
         warm_collectives(plan)
     step_fn = make_train_step(model, tx, plan=plan, graph=graph,
-                              trainable_mask=mask)
+                              trainable_mask=mask, sentinel=res.sentinel,
+                              skip_nonfinite=res.skip_nonfinite)
     k = int(steps_per_dispatch)
     multi_fn = (make_multi_train_step(model, tx, k, plan=plan, graph=graph,
-                                      trainable_mask=mask) if k > 1 else None)
+                                      trainable_mask=mask,
+                                      sentinel=res.sentinel,
+                                      skip_nonfinite=res.skip_nonfinite)
+                if k > 1 else None)
     # device double-buffering: loaders that expose a ``put`` hook transfer
     # each batch from their prefetch thread (overlapping the previous
     # step's compute) instead of synchronously inside step dispatch; at
@@ -234,6 +349,30 @@ def fit(cfg: Config, model, params, train_loader,
     speedo_cb = speedo if proc0 else (lambda *a, **k: None)
     bank = MetricBank()
     key = jax.random.PRNGKey(seed)
+    if restored_key is not None:
+        # the trainer key as it was at the interruption's save boundary:
+        # the resumed per-step key stream continues bit-exactly
+        key = jax.numpy.asarray(restored_key)
+
+    # auto-resume loader fast-forward: burn the completed epochs' RNG
+    # draws, then arm the resumed epoch's batch skip (the plan is drawn in
+    # full and sliced, so the tail is identical to the uninterrupted run)
+    if res.auto_resume and begin_epoch > begin0:
+        if hasattr(train_loader, "advance_epochs"):
+            train_loader.advance_epochs(begin_epoch - begin0)
+        else:
+            logger.warning("auto-resume: loader has no advance_epochs(); "
+                           "the resumed epochs' schedules will replay the "
+                           "loader's first-epoch RNG draws")
+    if step_resume is not None:
+        if not hasattr(train_loader, "skip_next"):
+            raise ValueError(
+                "auto_resume hit a mid-epoch step checkpoint but the "
+                "loader has no skip_next() — cannot fast-forward "
+                f"{type(train_loader).__name__} to batch {step_resume[1]}")
+        train_loader.skip_next(step_resume[1])
+
+    nan_at = nan_injection_step()  # env fault injection (fault_smoke.sh)
 
     profiling = False
     profiled = False
@@ -259,14 +398,88 @@ def fit(cfg: Config, model, params, train_loader,
             tel.counter("train/recompile")
             tel.meta("recompile", program=fn_kind, shape=list(shape))
 
-    for epoch in range(begin_epoch, end_epoch):
+    guard = PreemptionGuard()
+    preempted = False
+    last_saved = None  # (epoch, consumed) of the last written step ckpt
+
+    def save_step_ckpt(ep, cur):
+        """Step checkpoint of the CURRENT state (idempotent per position —
+        a preemption landing on a just-saved boundary must not re-save
+        into the same orbax key)."""
+        nonlocal last_saved
+        if last_saved == (ep, cur):
+            return
+        ckpt.save_step(ep, cur, state.params, cfg,
+                       opt_state=state.opt_state,
+                       step=int(jax.device_get(state.step)), rng_key=key)
+        last_saved = (ep, cur)
+
+    def handle_nonfinite(ep, cur, fetched):
+        """The sentinel tripped at a fetch boundary — apply ``nan_policy``.
+        Returns True when state was rolled back (the caller must suppress
+        this boundary's step save)."""
+        nonlocal state
+        tel.counter("train/nan_detected")
+        tel.meta("nan_detected", epoch=int(ep), consumed=int(cur),
+                 policy=res.nan_policy)
+        logger.warning("non-finite loss/gradients detected (epoch %d, "
+                       "batch %d, policy=%s)", ep, cur, res.nan_policy)
+        if res.nan_policy == "skip":
+            # the in-graph guard already discarded the bad update(s);
+            # params were never poisoned — count and continue
+            tel.counter("train/nan_skipped")
+            return False
+        if res.nan_policy == "halt":
+            path = dump_nan_diagnostics(
+                telemetry_dir or prefix, ep, cur,
+                int(jax.device_get(state.step)), fetched)
+            raise NonFiniteLossError(
+                f"non-finite loss/gradients at epoch {ep}, batch {cur} "
+                f"(policy=halt)"
+                + (f"; diagnostics dumped to {path}" if path else ""))
+        # rollback: restore the latest step checkpoint in-memory and keep
+        # consuming the loader — the poisoned stretch contributes nothing
+        # (schedule counts resume from the checkpoint, so the LR step
+        # count lags by the rolled-back stretch; accepted)
+        point = ckpt.latest_step_checkpoint() if ckpt is not None else None
+        if point is None:
+            raise NonFiniteLossError(
+                f"non-finite loss/gradients at epoch {ep}, batch {cur} "
+                f"(policy=rollback) with no step checkpoint to roll back "
+                f"to — set save_every_n_steps (prefix: {prefix or 'none'})")
+        g_ep, g_cons = point
+        abstract = {"params": jax.device_get(state.params),
+                    "opt_state": jax.device_get(state.opt_state),
+                    "step": 0, "epoch": 0, "consumed": 0,
+                    "rng_key": np.zeros((2,), np.uint32)}
+        payload = ckpt.load_step_checkpoint(g_ep, g_cons,
+                                            abstract_payload=abstract)
+        r_opt = payload.get("opt_state")
+        state = TrainState(
+            step=jax.numpy.asarray(payload["step"], jax.numpy.int32),
+            params=_runtime_owned(payload["params"]),
+            opt_state=(_runtime_owned(r_opt) if r_opt is not None
+                       else state.opt_state))
+        tel.counter("train/nan_rollback")
+        logger.warning("rolled back to step checkpoint (epoch %d, batch "
+                       "%d)", g_ep, g_cons)
+        return True
+
+    with (guard if res.enabled else contextlib.nullcontext()):
+      for epoch in range(begin_epoch, end_epoch):
         bank.reset()
         speedo.reset()
         pending = None
         buf = []
-        consumed = 0  # loader batches dispatched so far (a group item
-        # advances this by k; profiling and metric cadence count batches)
-        last_fetch = 0
+        # loader batches dispatched so far (a group item advances this by
+        # k; profiling and metric cadence count batches).  A mid-epoch
+        # resume starts the counters at the restored position — the
+        # fast-forwarded loader yields exactly the tail.
+        start_consumed = (step_resume[1]
+                          if step_resume and epoch == begin_epoch else 0)
+        consumed = start_consumed
+        last_fetch = start_consumed
+        last_step_save = start_consumed
         start_at = min(3, steps_per_epoch - 1)
         # epoch wall-time breakdown, telemetry-or-not (the epoch-end log
         # line reports wall/loader-wait either way; two perf_counter reads
@@ -283,6 +496,14 @@ def fit(cfg: Config, model, params, train_loader,
             dt_wait = time.perf_counter() - t_wait
             loader_wait_s += dt_wait
             tel.add("train/loader_wait", dt_wait)
+            if (nan_at is not None and consumed == nan_at
+                    and isinstance(item, dict)):
+                # env fault injection (script/fault_smoke.sh): poison this
+                # batch's images so the step's loss/grads go non-finite
+                item = dict(item)
+                item["images"] = item["images"] * np.float32("nan")
+                logger.warning("fault injection: NaN images at batch %d "
+                               "(MXR_FAULT_NAN_STEP)", consumed)
             if profile_dir and epoch == begin_epoch and not profiled:
                 if not profiling and consumed >= start_at:
                     jax.profiler.start_trace(profile_dir)
@@ -339,17 +560,52 @@ def fit(cfg: Config, model, params, train_loader,
                     pending = metrics
                     buf = []
             tel.add("train/dispatch", time.perf_counter() - t_disp, n=n_b)
+            cur = consumed + n_b
             # fetch metrics only at Speedometer cadence: a device→host scalar
             # read stalls the dispatch pipeline (and on tunneled devices costs
-            # far more than a step), so per-step reads would serialize training
-            if consumed + n_b - last_fetch >= frequent and pending is not None:
+            # far more than a step), so per-step reads would serialize
+            # training.  A due step save under an active sentinel forces the
+            # fetch first, so checkpoints only capture verified-finite state;
+            # saves happen only with ``buf`` empty (pulled-not-dispatched
+            # batches would desync the saved position from the state).
+            save_due = (res.save_every_n_steps > 0 and ckpt is not None
+                        and not buf
+                        and cur - last_step_save >= res.save_every_n_steps)
+            fetch_due = (cur - last_fetch >= frequent
+                         or (save_due and res.sentinel))
+            if fetch_due and pending is not None:
                 with tel.span("train/fetch_stall"):
-                    bank.update(jax.device_get(pending))
+                    fetched = jax.device_get(pending)
                 pending = None
-                last_fetch = consumed + n_b
+                last_fetch = cur
+                finite = fetched.pop("all_finite", None)
+                bank.update(fetched)
+                if finite is not None and finite < 1.0:
+                    if handle_nonfinite(epoch, cur, fetched):
+                        save_due = False  # just restored FROM a checkpoint
+                        last_step_save = cur
+            if save_due:
+                save_step_ckpt(epoch, cur)
+                last_step_save = cur
+            # preemption: single-process reads the flag at every boundary;
+            # multi-process must agree at deterministic lockstep points —
+            # the fetch boundaries — or a rank saving alone would deadlock
+            # orbax's cross-process barriers
+            if jax.process_count() > 1:
+                want_stop = (preemption_agreed(guard.requested)
+                             if fetch_due else False)
+            else:
+                want_stop = guard.requested
+            if want_stop and not buf:
+                if ckpt is not None:
+                    save_step_ckpt(epoch, cur)
+                tel.counter("train/preempted")
+                preempted = True
             for j in range(n_b):
                 speedo_cb(epoch, consumed + j, bank.format())
             consumed += n_b
+            if preempted:
+                break
         if buf:  # epoch remainder (< k) — flushed AFTER the loop so the
             # drain cannot depend on steps_per_epoch matching the
             # iterator's true yield count (wrapper loaders may differ)
@@ -371,10 +627,14 @@ def fit(cfg: Config, model, params, train_loader,
             logger.info("wrote device trace to %s", profile_dir)
         if pending is not None:
             with tel.span("train/fetch_stall"):
-                bank.update(jax.device_get(pending))
+                fetched = jax.device_get(pending)
+            finite = fetched.pop("all_finite", None)
+            bank.update(fetched)
+            if finite is not None and finite < 1.0:
+                handle_nonfinite(epoch, consumed, fetched)
         ep_wall = time.perf_counter() - ep_t0
         tel.add("train/epoch", ep_wall)
-        tel.counter("train/steps", consumed)
+        tel.counter("train/steps", consumed - start_consumed)
         if proc0:
             # wall + loader-wait on the one-line epoch summary: single-log
             # triage of "slow epoch — device or input pipeline?" without
@@ -382,6 +642,12 @@ def fit(cfg: Config, model, params, train_loader,
             logger.info("Epoch[%d] Train-%s\tWall=%.1fs LoaderWait=%.1fs",
                         epoch, bank.format().replace("\t", " Train-"),
                         ep_wall, loader_wait_s)
+        if preempted:
+            if proc0:
+                logger.info("preemption requested — exiting cleanly after "
+                            "step checkpoint (epoch %d, batch %d); rerun "
+                            "with auto_resume to continue", epoch, consumed)
+            break
         if ckpt is not None:
             # multi-host: EVERY rank calls save — orbax's CheckpointManager
             # runs its own cross-process barriers inside save() and writes

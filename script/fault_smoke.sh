@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# Fault-tolerance smoke, through the real CLI driver (tests/faults.py covers
+# the in-process paths; this exercises the env-driven injectors + signals):
+#
+#   run 1  synthetic train, SIGTERM'd once the first mid-epoch step
+#          checkpoint lands -> must exit cleanly (preemption save)
+#   run 2  the SAME command again — --auto-resume picks the step checkpoint,
+#          zero manual flags -> must complete every epoch
+#   run 3  one injected bad roidb record (MXR_FAULT_BAD_RECORD) + one
+#          injected NaN step (MXR_FAULT_NAN_STEP) under --nan-policy
+#          rollback -> must finish, with every recovery counter visible in
+#          scripts/telemetry_report.py's "recovery event" section
+set -e
+
+ckpt=${FAULT_CKPT:-/tmp/mxr_fault_smoke_ckpt}
+ckpt3=${FAULT_CKPT3:-/tmp/mxr_fault_smoke_ckpt3}
+tel1=${FAULT_TEL1:-/tmp/mxr_fault_smoke_tel1}
+tel2=${FAULT_TEL2:-/tmp/mxr_fault_smoke_tel2}
+tel3=${FAULT_TEL3:-/tmp/mxr_fault_smoke_tel3}
+rm -rf "$ckpt" "$ckpt3" "$tel1" "$tel2" "$tel3"
+
+# tiny synthetic config (the tests' shapes) so the smoke compiles fast
+base=(--network resnet50 --synthetic --synthetic_images 16
+  --cfg "tpu__SCALES=((64,96),)" --cfg "tpu__MAX_GT=4"
+  --cfg "network__ANCHOR_SCALES=(2,4)"
+  --cfg "TRAIN__RPN_PRE_NMS_TOP_N=200"
+  --cfg "TRAIN__RPN_POST_NMS_TOP_N=32"
+  --cfg "TRAIN__BATCH_ROIS=16"
+  --frequent 1 "$@")
+
+echo "== run 1: train until the first step checkpoint, then SIGTERM =="
+python train_end2end.py "${base[@]}" --prefix "$ckpt" --end_epoch 2 \
+  --save-every-n-steps 4 --auto-resume --telemetry-dir "$tel1" &
+pid=$!
+for _ in $(seq 1 1200); do
+  kill -0 "$pid" 2>/dev/null || break
+  # any entry under steps/ (orbax tmp dirs included) = a step save started
+  if ls "$ckpt/steps" 2>/dev/null | grep -q '[0-9]'; then break; fi
+  sleep 0.5
+done
+kill -TERM "$pid" 2>/dev/null || true
+wait "$pid"   # non-zero = the preemption path did NOT exit cleanly
+
+echo "== run 2: same command, --auto-resume continues from the step ckpt =="
+python train_end2end.py "${base[@]}" --prefix "$ckpt" --end_epoch 2 \
+  --save-every-n-steps 4 --auto-resume --telemetry-dir "$tel2"
+python - "$ckpt" <<'EOF'
+import sys
+from mx_rcnn_tpu.train.checkpoint import CheckpointManager
+eps = CheckpointManager(sys.argv[1]).available_epochs()
+assert 2 in eps, f"auto-resume did not complete: epochs present {eps}"
+print("auto-resume completed; epochs present:", eps)
+EOF
+
+echo "== run 1 telemetry: preemption recorded =="
+python scripts/telemetry_report.py "$tel1" | tee /tmp/mxr_fault_smoke_r1.txt
+grep -E '^train/preempted +[1-9]' /tmp/mxr_fault_smoke_r1.txt
+
+echo "== run 3: injected bad record + NaN step under --nan-policy rollback =="
+MXR_FAULT_BAD_RECORD=3 MXR_FAULT_NAN_STEP=6 \
+python train_end2end.py "${base[@]}" --prefix "$ckpt3" --end_epoch 1 \
+  --nan-policy rollback --save-every-n-steps 2 --telemetry-dir "$tel3"
+python scripts/telemetry_report.py "$tel3" | tee /tmp/mxr_fault_smoke_r3.txt
+grep -E '^loader/bad_record +[1-9]' /tmp/mxr_fault_smoke_r3.txt
+grep -E '^train/nan_detected +[1-9]' /tmp/mxr_fault_smoke_r3.txt
+grep -E '^train/nan_rollback +[1-9]' /tmp/mxr_fault_smoke_r3.txt
+
+echo "fault smoke OK"

@@ -12,6 +12,11 @@ inside — the multi-host layout) and explicit event files; multiple runs
 fold into one aggregate, which is how the bench trajectory accumulates
 across sessions.  Pure host-side JSON folding: no jax import, safe on a
 machine with no accelerator.
+
+The table includes a "recovery event" section (loader/bad_record,
+train/nan_*, train/preempted, checkpoint/retry — zeros included) so
+fault-tolerance triage reads off one block; script/fault_smoke.sh
+asserts on it.
 """
 
 import argparse

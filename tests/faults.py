@@ -1,0 +1,121 @@
+"""Deterministic fault-injection harness for the resilience subsystem
+(ISSUE 2 tentpole).  NOT a test module — pytest ignores it (no ``test_``
+prefix); tests/test_resilience.py drives every injector, and the
+env-driven CLI-level injectors live in ``mx_rcnn_tpu/train/resilience.py``
+(``MXR_FAULT_*``) for script/fault_smoke.sh.
+
+Injectors:
+
+* :func:`corrupt_record` — make one roidb record unloadable (exercises the
+  loader's bad-record isolation).
+* :class:`NanBatchLoader` — poison the images of one global batch with NaN
+  (exercises the train-step sentinel + nan policies).
+* :class:`SignalAtBatchLoader` — raise SIGTERM/SIGINT in the consumer
+  thread while a chosen batch is being pulled (exercises graceful
+  preemption at an exact, reproducible step boundary).
+* :func:`flaky_saves` — fail the first N orbax saves with OSError
+  (exercises checkpoint I/O retry).
+* :func:`hang_until` — a producer generator that yields its items then
+  blocks until released (exercises the prefetch-queue watchdog).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+import time
+
+import numpy as np
+
+
+def corrupt_record(roidb: list, i: int) -> list:
+    """Make ``roidb[i]`` unloadable: drop inline pixels, point the image
+    path at nothing — ``_load_record`` raises on it."""
+    rec = dict(roidb[i])
+    rec.pop("image_array", None)
+    rec["image"] = "/nonexistent/faults_harness_corrupt.jpg"
+    roidb[i] = rec
+    return roidb
+
+
+class NanBatchLoader:
+    """Wrap a train loader; the ``n``-th yielded batch (counted globally
+    across epochs) gets all-NaN images."""
+
+    def __init__(self, inner, n: int):
+        self._inner = inner
+        self._n = n
+        self._count = 0
+        self.batch_size = inner.batch_size
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self._inner.steps_per_epoch
+
+    def __iter__(self):
+        for b in self._inner:
+            if self._count == self._n:
+                b = dict(b)
+                b["images"] = np.full_like(b["images"], np.nan)
+            self._count += 1
+            yield b
+
+
+class SignalAtBatchLoader:
+    """Wrap a train loader; raise ``sig`` on the consumer thread right
+    before yielding batch ``at`` (global count) — the trainer's handler
+    sets its flag, batch ``at`` still dispatches, and the preemption save
+    lands at the following boundary (``consumed = at + 1``), every run."""
+
+    def __init__(self, inner, at: int, sig=signal.SIGTERM):
+        self._inner = inner
+        self._at = at
+        self._sig = sig
+        self._count = 0
+        self.batch_size = inner.batch_size
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self._inner.steps_per_epoch
+
+    def __iter__(self):
+        for b in self._inner:
+            if self._count == self._at:
+                signal.raise_signal(self._sig)
+            self._count += 1
+            yield b
+
+
+@contextlib.contextmanager
+def flaky_saves(n: int, exc=OSError):
+    """Patch ``orbax.checkpoint.CheckpointManager.save`` to raise ``exc``
+    for the first ``n`` calls, then behave normally — the transient-
+    filesystem-error shape ``resilience.retry_io`` exists for.  Yields the
+    mutable ``{"left": remaining}`` counter."""
+    import orbax.checkpoint as ocp
+
+    orig = ocp.CheckpointManager.save
+    calls = {"left": n}
+
+    def save(self, *a, **k):
+        if calls["left"] > 0:
+            calls["left"] -= 1
+            raise exc("injected transient save failure (tests/faults.py)")
+        return orig(self, *a, **k)
+
+    ocp.CheckpointManager.save = save
+    try:
+        yield calls
+    finally:
+        ocp.CheckpointManager.save = orig
+
+
+def hang_until(event, items):
+    """Producer generator: yield ``items``, then spin until ``event`` is
+    set — a stuck-but-alive producer (hung filesystem read) for the
+    prefetch watchdog.  Set ``event`` in the test's cleanup so the
+    producer thread exits promptly."""
+    for it in items:
+        yield it
+    while not event.is_set():
+        time.sleep(0.02)

@@ -30,7 +30,7 @@ from mx_rcnn_tpu.tools.common import (CappedLoader, add_common_args,
                                       check_dist_loader, config_from_args,
                                       get_imdb, get_train_roidb,
                                       init_or_load_params, setup_parallel)
-from mx_rcnn_tpu.train import fit
+from mx_rcnn_tpu.train import ResilienceOptions, fit
 
 
 def parse_args():
@@ -75,7 +75,8 @@ def train_net(args):
                 profile_dir=getattr(args, "profile", "") or None,
                 telemetry_dir=getattr(args, "telemetry_dir", "") or None,
                 steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
-                fixed_prefixes=cfg.network.FIXED_PARAMS)
+                fixed_prefixes=cfg.network.FIXED_PARAMS,
+                resilience=ResilienceOptions.from_args(args))
     return state
 
 
