@@ -12,5 +12,6 @@
 
 from mx_rcnn_tpu.data.image import get_image, transform_image, resize_to_bucket
 from mx_rcnn_tpu.data.imdb import IMDB
-from mx_rcnn_tpu.data.loader import AnchorLoader, TestLoader, ROIIter
+from mx_rcnn_tpu.data.loader import (AnchorLoader, TestLoader, ROIIter,
+                                     prepare_image)
 from mx_rcnn_tpu.data.synthetic import SyntheticDataset

@@ -38,6 +38,22 @@ from mx_rcnn_tpu.logger import logger
 MAX_CONSECUTIVE_BAD_RECORDS = 8
 
 
+def prepare_image(im: np.ndarray, cfg: Config,
+                  scale: Tuple[int, int]) -> Tuple[np.ndarray, np.ndarray]:
+    """Raw RGB HWC image → (bucket-padded network input, im_info) — the
+    image half of ``_load_record``, shared with the serve engine
+    (``mx_rcnn_tpu/serve``) so an online request goes through byte-for-byte
+    the same transform chain as an eval batch: pixel normalize → resize by
+    the reference rule → zero-pad into the orientation's static bucket →
+    optional host space-to-depth."""
+    im = transform_image(im, cfg.network.PIXEL_MEANS, cfg.network.PIXEL_STDS)
+    stride = max(cfg.network.IMAGE_STRIDE, cfg.network.RPN_FEAT_STRIDE)
+    padded, s, (eh, ew) = resize_to_bucket(im, scale, stride)
+    if cfg.network.HOST_S2D:
+        padded = space_to_depth2(padded)
+    return padded, np.asarray([eh, ew, s], np.float32)
+
+
 def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
                  with_masks: bool = False) -> dict:
     """roidb record → one transformed sample (host numpy).
@@ -50,12 +66,8 @@ def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
             im = im[:, ::-1, :]
     else:
         im = get_image(rec["image"], flipped=rec.get("flipped", False))
-    im = transform_image(im, cfg.network.PIXEL_MEANS, cfg.network.PIXEL_STDS)
-    stride = max(cfg.network.IMAGE_STRIDE, cfg.network.RPN_FEAT_STRIDE)
-    padded, s, (eh, ew) = resize_to_bucket(im, scale, stride)
-
-    if cfg.network.HOST_S2D:
-        padded = space_to_depth2(padded)
+    padded, im_info = prepare_image(im, cfg, scale)
+    s = float(im_info[2])
 
     g = cfg.tpu.MAX_GT
     boxes = np.zeros((g, 4), np.float32)
@@ -66,8 +78,7 @@ def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
         boxes[:n] = rec["boxes"][:n] * s  # gt scaled into the resized frame
         classes[:n] = rec["gt_classes"][:n]
         valid[:n] = True
-    out = dict(images=padded,
-               im_info=np.asarray([eh, ew, s], np.float32),
+    out = dict(images=padded, im_info=im_info,
                gt_boxes=boxes, gt_classes=classes, gt_valid=valid)
     if with_masks and cfg.network.HAS_MASK:
         from mx_rcnn_tpu.data.mask import rasterize_gt_masks

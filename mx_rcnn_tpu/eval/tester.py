@@ -23,8 +23,7 @@ from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.loader import TestLoader
 from mx_rcnn_tpu.logger import logger
-from mx_rcnn_tpu.native import nms  # C++ fast path, numpy fallback inside
-from mx_rcnn_tpu.ops.boxes import bbox_pred as decode_boxes, clip_boxes
+from mx_rcnn_tpu.ops.postprocess import decode_image_boxes, per_class_nms
 
 
 class Predictor:
@@ -57,7 +56,13 @@ class Predictor:
                     "eval on a single-process mesh (e.g. each host "
                     "evaluates its own roidb slice on its local devices, "
                     "like the reference's per-GPU pred_eval loop), or "
-                    "gate eval on process 0 with a local mesh")
+                    "gate eval on process 0 with a local mesh.  For "
+                    "online traffic, the serve subsystem "
+                    "(mx_rcnn_tpu/serve, `python serve.py`) wraps this "
+                    "same single-process Predictor behind a dynamic "
+                    "batcher — scale out by running one serve.py replica "
+                    "per host behind a load balancer, not by widening "
+                    "the mesh across processes")
             check_spatial(plan, cfg)  # thin-shard guard (mesh.py rationale)
             params = jax.device_put(params, plan.replicated())
             repl, bsh = plan.replicated(), plan.batch()
@@ -250,10 +255,9 @@ def im_detect(predictor: Predictor, batch: dict):
     n = int(np.sum(batch.get("batch_valid", np.ones(len(rois), bool))))
     with tel.span("eval/decode"):
         for b in range(n):
-            eh, ew, s = im_info[b]
-            boxes = decode_boxes(rois[b], bbox_deltas[b])  # (R, 4K)
-            boxes = clip_boxes(boxes, eh, ew)
-            boxes = np.asarray(boxes) / s                  # original frame
+            # shared post-process path (ops/postprocess.py): (R, 4K)
+            # boxes in the original image frame
+            boxes = decode_image_boxes(rois[b], bbox_deltas[b], im_info[b])
             out.append((cls_prob[b], boxes, roi_valid[b]))
     return out
 
@@ -340,24 +344,12 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
         t_nms = time.perf_counter()
         for b, (scores, boxes, valid) in enumerate(dets):
             i = int(indices[b])
-            v = np.asarray(valid, bool)
+            # shared post-process path (ops/postprocess.py) — the serve
+            # engine runs the identical block, pinned by a parity test
+            dets_pc = per_class_nms(scores, boxes, valid, num_classes,
+                                    thresh, cfg.TEST.NMS, max_per_image)
             for k in range(1, num_classes):
-                sel = (scores[:, k] > thresh) & v
-                cls_scores = scores[sel, k]
-                cls_boxes = boxes[sel, 4 * k:4 * (k + 1)]
-                cls_dets = np.hstack([cls_boxes, cls_scores[:, None]]).astype(
-                    np.float32)
-                keep = nms(cls_dets, cfg.TEST.NMS)
-                all_boxes[k][i] = cls_dets[keep]
-            # cap total detections per image (reference max_per_image block)
-            if max_per_image > 0:
-                scores_all = np.concatenate(
-                    [all_boxes[k][i][:, 4] for k in range(1, num_classes)])
-                if len(scores_all) > max_per_image:
-                    th = np.sort(scores_all)[-max_per_image]
-                    for k in range(1, num_classes):
-                        keep = all_boxes[k][i][:, 4] >= th
-                        all_boxes[k][i] = all_boxes[k][i][keep]
+                all_boxes[k][i] = dets_pc[k]
             if vis:
                 vis_dir = "vis"
                 os.makedirs(vis_dir, exist_ok=True)

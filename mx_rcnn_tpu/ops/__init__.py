@@ -19,3 +19,8 @@ from mx_rcnn_tpu.ops.assign_anchor import assign_anchor
 from mx_rcnn_tpu.ops.sample_rois import sample_rois
 from mx_rcnn_tpu.ops.proposal import propose
 from mx_rcnn_tpu.ops.roi_align import roi_align, roi_pool
+from mx_rcnn_tpu.ops.postprocess import (
+    decode_image_boxes,
+    per_class_nms,
+    detections_to_records,
+)

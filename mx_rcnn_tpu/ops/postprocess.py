@@ -1,0 +1,81 @@
+"""Shared host post-process: decode → clip → per-class NMS → max_per_image.
+
+This is the block every inference consumer runs after the device forward —
+``pred_eval``'s dataset loop, the online serve engine, and any future
+batch-prediction tool.  It used to live inline in ``eval/tester.py``; the
+serve subsystem needs the exact same math (a drifted copy would make served
+detections disagree with the eval metric for the same weights), so the
+single source of truth lives here and a parity test pins it to the
+reference block's semantics (``tests/test_serve.py``).
+
+All host numpy, off the hot path — identical accounting to the reference's
+``pred_eval`` (per-class score threshold → NMS → global per-image cap).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.ops.boxes import bbox_pred as decode_boxes, clip_boxes
+
+
+def decode_image_boxes(rois: np.ndarray, deltas: np.ndarray,
+                       im_info_row) -> np.ndarray:
+    """One image's raw RPN rois + head deltas → (R, 4K) boxes in ORIGINAL
+    image coordinates (reference ``im_detect``: bbox_pred + clip_boxes,
+    then divide by im_scale).  ``im_info_row`` is the (eh, ew, scale)
+    triple the loader ships."""
+    eh, ew, s = im_info_row
+    boxes = decode_boxes(rois, deltas)
+    boxes = clip_boxes(boxes, eh, ew)
+    return np.asarray(boxes) / s
+
+
+def per_class_nms(scores: np.ndarray, boxes: np.ndarray, valid,
+                  num_classes: int, thresh: float, nms_thresh: float,
+                  max_per_image: int, nms_fn=None) -> List[Optional[np.ndarray]]:
+    """One image's (R, K) scores + (R, 4K) original-frame boxes →
+    per-class (N, 5) [x1,y1,x2,y2,score] detections (reference
+    ``pred_eval`` inner block: per-class score threshold → NMS → global
+    per-image score cap).
+
+    Returns a list indexed by class; index 0 (background) is ``None``.
+    ``nms_fn`` defaults to the native C++ NMS (numpy fallback inside) —
+    injectable for oracle tests."""
+    if nms_fn is None:
+        from mx_rcnn_tpu.native import nms as nms_fn
+    v = np.asarray(valid, bool)
+    dets: List[Optional[np.ndarray]] = [None] * num_classes
+    for k in range(1, num_classes):
+        sel = (scores[:, k] > thresh) & v
+        cls_scores = scores[sel, k]
+        cls_boxes = boxes[sel, 4 * k:4 * (k + 1)]
+        cls_dets = np.hstack([cls_boxes, cls_scores[:, None]]).astype(
+            np.float32)
+        keep = nms_fn(cls_dets, nms_thresh)
+        dets[k] = cls_dets[keep]
+    # cap total detections per image (reference max_per_image block)
+    if max_per_image > 0:
+        scores_all = np.concatenate(
+            [dets[k][:, 4] for k in range(1, num_classes)])
+        if len(scores_all) > max_per_image:
+            th = np.sort(scores_all)[-max_per_image]
+            for k in range(1, num_classes):
+                dets[k] = dets[k][dets[k][:, 4] >= th]
+    return dets
+
+
+def detections_to_records(dets_per_class) -> List[dict]:
+    """Per-class (N, 5) arrays → flat JSON-serializable records sorted by
+    descending score — the serve response payload shape."""
+    out = []
+    for k, d in enumerate(dets_per_class):
+        if not k or d is None:
+            continue
+        for row in d:
+            out.append({"cls": int(k), "score": float(row[4]),
+                        "bbox": [float(c) for c in row[:4]]})
+    out.sort(key=lambda r: -r["score"])
+    return out

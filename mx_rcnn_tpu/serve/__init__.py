@@ -1,0 +1,27 @@
+"""Online inference serving — the first ONLINE workload on the stack.
+
+Layered on the eval machinery, nothing duplicated: requests go through the
+loader's image-prep chain (``data.prepare_image``), the ``Predictor``'s
+jitted bucket programs, and the shared ``ops/postprocess`` block that
+``pred_eval`` scores with.
+
+* ``engine``   — async queue + bucket-aware dynamic batcher (deadline
+  flush, partial-batch padding, bounded-queue backpressure).
+* ``frontend`` — stdlib HTTP endpoints (``/predict``, ``/healthz``,
+  ``/metrics``) over TCP or a Unix socket, plus a stdio mode.
+* ``warmup``   — eager compilation of every (bucket, batch) program so
+  the first request never pays XLA compile.
+
+Driver: top-level ``serve.py``; load generator: ``scripts/loadgen.py``;
+throughput: ``bench.py --mode serve``; smoke: ``script/serve_smoke.sh``.
+"""
+
+from mx_rcnn_tpu.serve.engine import (DeadlineExceededError, RejectedError,
+                                      ServeEngine, ServeFuture, ServeOptions)
+from mx_rcnn_tpu.serve.frontend import (encode_image_payload, make_server,
+                                        run_stdio, unix_http_request)
+from mx_rcnn_tpu.serve.warmup import warmup
+
+__all__ = ["ServeEngine", "ServeOptions", "ServeFuture", "RejectedError",
+           "DeadlineExceededError", "make_server", "run_stdio",
+           "unix_http_request", "encode_image_payload", "warmup"]

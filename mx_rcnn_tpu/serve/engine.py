@@ -1,0 +1,375 @@
+"""Online serving engine: bucket-aware dynamic batcher over ``Predictor``.
+
+The offline paths (``pred_eval``, ``bench.py --mode infer``) fill batches
+from a dataset; online traffic arrives one image at a time, at arbitrary
+sizes, and Faster R-CNN inference is throughput-bound on batch fill.
+Iteration-level dynamic batching (the Clipper recipe, Crankshaw et al.,
+NSDI 2017) is exactly what the static-shape bucket design enables: every
+request is resized+padded into one of a small set of pre-compiled bucket
+shapes (``data.prepare_image``, the same chain the eval loader runs), so
+mixed-size traffic coalesces into full batches of a handful of jit
+programs with zero steady-state recompiles.
+
+Mechanics:
+
+* ``submit`` preps the image ON THE CALLER'S THREAD (frontend request
+  threads parallelize the cv2 resize, the host-side cost), routes it to
+  its orientation bucket queue, and returns a :class:`ServeFuture`.
+* One dispatcher thread owns the device: it flushes a bucket when it has
+  ``batch_size`` requests, or when its oldest request has waited
+  ``max_delay_ms`` (the latency/throughput knob — 0 serves singletons
+  immediately, larger values trade head-of-line latency for fill).
+  Partial batches are padded with repeats of the last request (the
+  TestLoader recipe) and the padding rows are masked out of responses.
+* Backpressure is a bounded queue: ``submit`` beyond ``max_queue``
+  raises :class:`RejectedError` (the frontend's 503) instead of letting
+  latency grow without bound.  Per-request deadlines are swept before
+  every flush: an expired request fails with
+  :class:`DeadlineExceededError` (504) without wasting a forward pass.
+* Post-process is the shared ``ops/postprocess`` path — byte-for-byte
+  the block ``pred_eval`` runs, so served detections can never drift
+  from the eval metric for the same weights.
+
+Telemetry (whatever sink is active): per-request ``serve/queue_wait``
+spans; per-batch ``serve/forward`` / ``serve/readback`` /
+``serve/postprocess`` spans and ``serve/batch_fill`` / ``serve/pad_ratio``
+gauges; ``serve/requests`` / ``serve/batches`` / ``serve/rejected`` /
+``serve/deadline_exceeded`` / ``serve/recompile`` counters.  The same
+counts are mirrored in :attr:`ServeEngine.counters` so ``/metrics`` works
+with telemetry disabled.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.data.image import bucket_shape
+from mx_rcnn_tpu.data.loader import prepare_image
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.ops.postprocess import (decode_image_boxes,
+                                         detections_to_records,
+                                         per_class_nms)
+
+
+class RejectedError(RuntimeError):
+    """Queue full (or engine stopped) — the frontend's 503."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline expired before it was served — 504."""
+
+
+@dataclass(frozen=True)
+class ServeOptions:
+    """Engine knobs (CLI: ``--serve-batch`` / ``--max-delay-ms`` /
+    ``--max-queue`` / ``--deadline-ms``)."""
+
+    batch_size: int = 4
+    # flush a partial batch once its oldest request has waited this long;
+    # THE latency/throughput knob (0 = serve singletons immediately)
+    max_delay_ms: float = 10.0
+    # bounded-queue backpressure: submits beyond this many queued requests
+    # (across all buckets) are rejected, not parked
+    max_queue: int = 64
+    # default per-request deadline (<= 0 disables); requests may override
+    deadline_ms: float = 30000.0
+
+    def __post_init__(self):
+        if self.batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {self.batch_size}")
+        if self.max_queue < self.batch_size:
+            raise ValueError(
+                f"max_queue ({self.max_queue}) must be >= batch_size "
+                f"({self.batch_size}) or a full batch could never queue")
+
+
+class ServeFuture:
+    """Completion handle for one submitted request."""
+
+    __slots__ = ("_event", "_result", "_error", "queue_wait_s")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self.queue_wait_s: Optional[float] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[dict]:
+        """Block for the detections (records sorted by descending score:
+        ``{"cls", "score", "bbox": [x1,y1,x2,y2]}`` in ORIGINAL image
+        coordinates).  Raises the request's failure if it was rejected,
+        expired, or the forward errored."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not served within wait timeout")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _set_result(self, result):
+        self._result = result
+        self._event.set()
+
+    def _set_error(self, err: BaseException):
+        self._error = err
+        self._event.set()
+
+
+class _Request:
+    __slots__ = ("image", "im_info", "t_enqueue", "deadline", "future")
+
+    def __init__(self, image, im_info, t_enqueue, deadline):
+        self.image = image          # bucket-padded network input
+        self.im_info = im_info
+        self.t_enqueue = t_enqueue  # monotonic
+        self.deadline = deadline    # monotonic instant or None
+        self.future = ServeFuture()
+
+
+class ServeEngine:
+    """The dynamic batcher.  ``start()`` before submitting; ``stop()``
+    fails whatever is still queued (a draining stop would hold clients
+    through a full queue's worth of forwards)."""
+
+    def __init__(self, predictor, cfg: Config,
+                 options: Optional[ServeOptions] = None):
+        self.predictor = predictor
+        self.cfg = cfg
+        self.opts = options or ServeOptions()
+        # serving pins SCALES[0] exactly like the TEST path (TestLoader):
+        # one (short, long) pair, two orientation buckets
+        self._scale = cfg.tpu.SCALES[0]
+        self._queues: Dict[Tuple[int, int], List[_Request]] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # program bookkeeping, the trainer's recompile-tracking recipe:
+        # jit caches one program per input shape, so the first dispatch of
+        # each bucket shape is the compile
+        self._seen_shapes = set()
+        self.counters = {"requests": 0, "served": 0, "batches": 0,
+                         "rejected": 0, "deadline_exceeded": 0,
+                         "recompiles": 0, "warmup_programs": 0}
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "ServeEngine":
+        assert self._thread is None, "engine already started"
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        name="serve-dispatch", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        with self._cond:
+            self._stop = True
+            pending = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+            self._cond.notify_all()
+        for r in pending:
+            r.future._set_error(RejectedError("engine stopped"))
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    # -- intake ----------------------------------------------------------
+
+    def bucket_key(self, h: int, w: int) -> Tuple[int, int]:
+        """The static padded (H, W) bucket a raw (h, w) image routes to —
+        orientation picks the compiled program, exactly like the loaders'
+        aspect grouping."""
+        return bucket_shape(self._scale,
+                            max(self.cfg.network.IMAGE_STRIDE,
+                                self.cfg.network.RPN_FEAT_STRIDE),
+                            landscape=(w >= h))
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
+
+    def submit(self, image: np.ndarray,
+               deadline_ms: Optional[float] = None) -> ServeFuture:
+        """Enqueue one raw RGB HWC image (uint8 or float).  Returns a
+        :class:`ServeFuture`; raises :class:`RejectedError` immediately
+        when the queue is full or the engine is stopped."""
+        if image.ndim != 3 or image.shape[2] != 3:
+            raise ValueError(f"expected (H, W, 3) RGB image, "
+                             f"got shape {tuple(image.shape)}")
+        tel = telemetry.get()
+        # host prep on the caller's thread: concurrent frontends
+        # parallelize the resize, and the dispatcher thread stays on the
+        # device hot path
+        prepared, im_info = prepare_image(np.asarray(image), self.cfg,
+                                          self._scale)
+        # route on the LOGICAL bucket (pre-s2d padded shape) — under
+        # HOST_S2D the prepared array is (H/2, W/2, 12), but orientation
+        # and program identity are the bucket's, and /metrics should name
+        # buckets in image coordinates
+        key = self.bucket_key(image.shape[0], image.shape[1])
+        now = time.monotonic()
+        if deadline_ms is None:
+            deadline_ms = self.opts.deadline_ms
+        deadline = now + deadline_ms / 1e3 if deadline_ms > 0 else None
+        req = _Request(prepared, im_info, now, deadline)
+        with self._cond:
+            if self._stop:
+                self.counters["rejected"] += 1
+                tel.counter("serve/rejected")
+                raise RejectedError("engine stopped")
+            depth = sum(len(q) for q in self._queues.values())
+            if depth >= self.opts.max_queue:
+                self.counters["rejected"] += 1
+                tel.counter("serve/rejected")
+                raise RejectedError(
+                    f"queue full ({depth}/{self.opts.max_queue} requests "
+                    f"pending) — retry with backoff")
+            self._queues.setdefault(key, []).append(req)
+            self.counters["requests"] += 1
+            tel.counter("serve/requests")
+            tel.gauge("serve/queue_depth", depth + 1)
+            self._cond.notify()
+        return req.future
+
+    def predict(self, image: np.ndarray,
+                deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = 60.0) -> List[dict]:
+        """Synchronous convenience: ``submit`` + wait."""
+        return self.submit(image, deadline_ms=deadline_ms).result(timeout)
+
+    # -- dispatch --------------------------------------------------------
+
+    def _sweep_expired_locked(self, now: float) -> List[_Request]:
+        expired = []
+        for q in self._queues.values():
+            live = []
+            for r in q:
+                (expired if r.deadline is not None and r.deadline <= now
+                 else live).append(r)
+            q[:] = live
+        return expired
+
+    def _next_batch_locked(self, now: float):
+        """(requests, None) when a bucket is due, else (None, wait_s).
+
+        Full buckets flush first; among due buckets the one whose
+        head-of-line request is OLDEST wins — deadline-ordered flushing,
+        so no bucket's traffic can starve another's latency budget."""
+        B = self.opts.batch_size
+        delay = self.opts.max_delay_ms / 1e3
+        best_key, best_t, best_full = None, None, False
+        wait = None
+        for key, q in self._queues.items():
+            if not q:
+                continue
+            head_t = q[0].t_enqueue
+            full = len(q) >= B
+            if not (full or (now - head_t) >= delay):
+                remaining = delay - (now - head_t)
+                wait = remaining if wait is None else min(wait, remaining)
+                continue
+            # full beats partial; among equals the oldest head wins
+            if best_key is None or (full, -head_t) > (best_full, -best_t):
+                best_key, best_t, best_full = key, head_t, full
+        if best_key is not None:
+            q = self._queues[best_key]
+            take, q[:] = q[:B], q[B:]
+            return take, None
+        return None, wait
+
+    def _dispatch_loop(self):
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                now = time.monotonic()
+                expired = self._sweep_expired_locked(now)
+                batch, wait = self._next_batch_locked(now)
+                if batch is None and not expired:
+                    self._cond.wait(timeout=wait)
+                    continue
+            for r in expired:
+                self.counters["deadline_exceeded"] += 1
+                telemetry.get().counter("serve/deadline_exceeded")
+                r.future._set_error(DeadlineExceededError(
+                    "request expired before it reached a batch (engine "
+                    "overloaded? raise --max-queue workers or add "
+                    "replicas)"))
+            if batch is not None:
+                try:
+                    self._run_batch(batch, time.monotonic())
+                except BaseException as e:  # noqa: BLE001 — fail the batch
+                    logger.exception("serve batch failed")
+                    for r in batch:
+                        r.future._set_error(e)
+
+    def _run_batch(self, reqs: List[_Request], now: float):
+        import jax
+
+        tel = telemetry.get()
+        B = self.opts.batch_size
+        pad = B - len(reqs)
+        for r in reqs:
+            r.future.queue_wait_s = now - r.t_enqueue
+            tel.add("serve/queue_wait", now - r.t_enqueue)
+        # pad partial batches with repeats (the TestLoader recipe); the
+        # padded rows never reach a response
+        images = np.stack([r.image for r in reqs]
+                          + [reqs[-1].image] * pad)
+        im_info = np.stack([r.im_info for r in reqs]
+                           + [reqs[-1].im_info] * pad)
+        tel.gauge("serve/batch_fill", len(reqs) / B)
+        tel.gauge("serve/pad_ratio", pad / B)
+        shape = tuple(images.shape)
+        if shape not in self._seen_shapes:
+            self._seen_shapes.add(shape)
+            self.counters["recompiles"] += 1
+            tel.counter("serve/recompile")
+            tel.meta("recompile", program="serve_predict", shape=list(shape))
+        with tel.span("serve/forward"):
+            rois, roi_valid, cls_prob, bbox_deltas, _ = \
+                self.predictor.predict(images, im_info)
+        with tel.span("serve/readback"):
+            rois, roi_valid, cls_prob, bbox_deltas = jax.device_get(
+                (rois, roi_valid, cls_prob, bbox_deltas))
+        cfg = self.cfg
+        with tel.span("serve/postprocess"):
+            for b, r in enumerate(reqs):
+                boxes = decode_image_boxes(rois[b], bbox_deltas[b],
+                                           np.asarray(r.im_info))
+                dets_pc = per_class_nms(cls_prob[b], boxes, roi_valid[b],
+                                        cfg.NUM_CLASSES, cfg.TEST.THRESH,
+                                        cfg.TEST.NMS,
+                                        cfg.TEST.MAX_PER_IMAGE)
+                r.future._set_result(detections_to_records(dets_pc))
+        with self._lock:
+            self.counters["batches"] += 1
+            self.counters["served"] += len(reqs)
+        tel.counter("serve/batches")
+        tel.counter("serve/images", len(reqs))
+
+    # -- introspection ---------------------------------------------------
+
+    def metrics(self) -> dict:
+        """The ``/metrics`` payload: counters + live queue state."""
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "queue_depth": sum(len(q) for q in self._queues.values()),
+                "buckets": {f"{h}x{w}": len(q)
+                            for (h, w), q in self._queues.items()},
+                "options": {"batch_size": self.opts.batch_size,
+                            "max_delay_ms": self.opts.max_delay_ms,
+                            "max_queue": self.opts.max_queue,
+                            "deadline_ms": self.opts.deadline_ms},
+            }

@@ -1,0 +1,235 @@
+"""Dependency-free serving frontends over :class:`ServeEngine`.
+
+Three transports, one JSON contract:
+
+* TCP HTTP (``make_server(engine, port=...)``) — the production-shaped
+  endpoint ``scripts/loadgen.py`` drives.
+* Unix-socket HTTP (``make_server(engine, unix_socket=path)``) — same
+  handler over ``AF_UNIX``; what the tier-1 tests round-trip (no port
+  allocation races on shared CI hosts).  ``unix_http_request`` is the
+  matching client.
+* stdio (``run_stdio``) — newline-delimited JSON over stdin/stdout for
+  debugging and pipe-based harnesses.
+
+Endpoints:
+
+* ``POST /predict`` — body ``{"shape": [h, w, 3], "data": <base64 raw
+  uint8 RGB bytes>}`` (or ``"pixels"``: nested lists), optional
+  ``"deadline_ms"``.  200 → ``{"detections": [{"cls", "score", "bbox"}...],
+  "queue_wait_ms"}``; 503 queue full (backpressure — retry with backoff);
+  504 deadline exceeded; 400 malformed.
+* ``GET /healthz`` — 200 once the engine thread is up.
+* ``GET /metrics`` — engine counters + queue state as JSON.
+
+Everything here is stdlib (``http.server`` + ``ThreadingHTTPServer``):
+request threads do the image prep in ``engine.submit`` concurrently, which
+is precisely what fills batches — a single-threaded frontend would
+serialize arrivals and the batcher would only ever see singletons.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socket
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.serve.engine import (DeadlineExceededError, RejectedError,
+                                      ServeEngine)
+
+# result-wait ceiling for one HTTP request; the engine's own per-request
+# deadline (default ServeOptions.deadline_ms) fires long before this —
+# the ceiling only bounds a wedged dispatcher so handler threads can't
+# accumulate forever
+WAIT_TIMEOUT_S = 600.0
+
+
+def decode_image_payload(doc: dict) -> np.ndarray:
+    """Request JSON → (H, W, 3) uint8 RGB array.  Raises ValueError on a
+    malformed payload (the handler's 400)."""
+    if "pixels" in doc:
+        img = np.asarray(doc["pixels"], np.uint8)
+    elif "data" in doc:
+        shape = doc.get("shape")
+        if (not isinstance(shape, (list, tuple)) or len(shape) != 3
+                or shape[2] != 3):
+            raise ValueError(f"'shape' must be [h, w, 3], got {shape!r}")
+        raw = base64.b64decode(doc["data"], validate=True)
+        h, w, c = (int(x) for x in shape)
+        if len(raw) != h * w * c:
+            raise ValueError(f"'data' holds {len(raw)} bytes, shape "
+                             f"{shape} needs {h * w * c}")
+        img = np.frombuffer(raw, np.uint8).reshape(h, w, c)
+    else:
+        raise ValueError("payload needs 'data'+'shape' (base64 raw RGB "
+                         "bytes) or 'pixels' (nested lists)")
+    if img.ndim != 3 or img.shape[2] != 3:
+        raise ValueError(f"expected (H, W, 3), got {img.shape}")
+    return img
+
+
+def encode_image_payload(img: np.ndarray) -> dict:
+    """The client half of the contract (loadgen, tests)."""
+    img = np.ascontiguousarray(img, np.uint8)
+    return {"shape": list(img.shape),
+            "data": base64.b64encode(img.tobytes()).decode("ascii")}
+
+
+def handle_request_doc(engine: ServeEngine, doc: dict) -> tuple:
+    """One predict request → (http_status, response_doc).  Shared by all
+    three transports so their status semantics cannot drift."""
+    try:
+        img = decode_image_payload(doc)
+    except (ValueError, TypeError, KeyError) as e:
+        return 400, {"error": str(e)}
+    try:
+        fut = engine.submit(img, deadline_ms=doc.get("deadline_ms"))
+        dets = fut.result(timeout=WAIT_TIMEOUT_S)
+    except RejectedError as e:
+        return 503, {"error": str(e)}
+    except DeadlineExceededError as e:
+        return 504, {"error": str(e)}
+    except TimeoutError as e:
+        return 504, {"error": str(e)}
+    except Exception as e:  # noqa: BLE001 — surface as a 500, keep serving
+        logger.exception("predict failed")
+        return 500, {"error": f"{type(e).__name__}: {e}"}
+    qms = (fut.queue_wait_s or 0.0) * 1e3
+    return 200, {"detections": dets, "queue_wait_ms": round(qms, 3)}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    engine: ServeEngine = None  # set by make_server subclassing
+
+    # -- plumbing --------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # route through our logger
+        logger.debug("serve http: " + fmt, *args)
+
+    def address_string(self):  # AF_UNIX peers have no (host, port)
+        if isinstance(self.client_address, (bytes, str)):
+            return "unix"
+        return super().address_string()
+
+    def _reply(self, status: int, doc: dict):
+        body = json.dumps(doc).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- endpoints -------------------------------------------------------
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, {"status": "ok",
+                              "queue_depth": self.engine.queue_depth()})
+        elif self.path == "/metrics":
+            self._reply(200, self.engine.metrics())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/predict":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(length))
+        except (ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad JSON body: {e}"})
+            return
+        self._reply(*handle_request_doc(self.engine, doc))
+
+
+class _TCPHTTPServer(ThreadingHTTPServer):
+    # the stdlib default listen backlog (5) drops connections under the
+    # very bursts the engine's backpressure exists to answer with 503s;
+    # admission control is the engine's job, not the kernel's
+    request_queue_size = 128
+
+
+class _UnixHTTPServer(_TCPHTTPServer):
+    address_family = socket.AF_UNIX
+
+    def server_bind(self):
+        # stale socket files from a killed process block bind
+        if os.path.exists(self.server_address):
+            os.unlink(self.server_address)
+        super().server_bind()
+
+    def client_address_string(self):
+        return "unix"
+
+
+def make_server(engine: ServeEngine, port: Optional[int] = None,
+                host: str = "127.0.0.1",
+                unix_socket: Optional[str] = None):
+    """Build (not start) the HTTP server — exactly one of ``port`` /
+    ``unix_socket``.  Caller owns ``serve_forever``/``shutdown``."""
+    if (port is None) == (unix_socket is None):
+        raise ValueError("pass exactly one of port / unix_socket")
+
+    class Handler(_Handler):
+        pass
+
+    Handler.engine = engine
+    if unix_socket is not None:
+        return _UnixHTTPServer(unix_socket, Handler)
+    return _TCPHTTPServer((host, port), Handler)
+
+
+def unix_http_request(sock_path: str, method: str, path: str,
+                      doc: Optional[dict] = None,
+                      timeout: float = 60.0) -> tuple:
+    """Minimal HTTP client over a Unix socket → (status, response_doc).
+    The test/loadgen counterpart of ``make_server(unix_socket=...)``."""
+    import http.client
+
+    class Conn(http.client.HTTPConnection):
+        def __init__(self):
+            super().__init__("localhost", timeout=timeout)
+
+        def connect(self):
+            self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self.sock.settimeout(timeout)
+            self.sock.connect(sock_path)
+
+    conn = Conn()
+    try:
+        body = json.dumps(doc).encode() if doc is not None else None
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json"}
+                     if body else {})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def run_stdio(engine: ServeEngine, inp=None, out=None):
+    """Newline-delimited JSON over stdin/stdout: each input line is a
+    predict payload, each output line ``{"status": N, ...response}``.
+    Returns on EOF."""
+    inp = inp if inp is not None else sys.stdin
+    out = out if out is not None else sys.stdout
+    for line in inp:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError as e:
+            status, resp = 400, {"error": f"bad JSON line: {e}"}
+        else:
+            status, resp = handle_request_doc(engine, doc)
+        out.write(json.dumps({"status": status, **resp}) + "\n")
+        out.flush()

@@ -1,0 +1,51 @@
+"""Startup warmup: eagerly compile every (bucket, batch) program.
+
+XLA compiles the forward on first dispatch of each input shape — tens of
+seconds for the real backbones.  Without warmup the first user request of
+each orientation pays that compile inside its latency budget (and usually
+blows its deadline).  Warmup pushes one full batch of dummy pixels per
+bucket through the REAL engine path — same queue, same padding, same
+post-process — so every program the steady state can dispatch is compiled
+before the frontend accepts traffic, and the engine's recompile counter
+(the trainer's shape-keyed bookkeeping) proves it: after warmup,
+``counters["recompiles"] == counters["warmup_programs"]`` must hold for
+the life of the process (asserted by ``script/serve_smoke.sh`` and
+``tests/test_serve.py``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.logger import logger
+
+
+def warmup(engine) -> int:
+    """Compile every (bucket, batch) program through a STARTED engine.
+
+    Submits ``batch_size`` dummy images per orientation (full batches →
+    immediate flush, no delay wait) and blocks until served.  Returns the
+    number of programs compiled; stamps it into
+    ``engine.counters["warmup_programs"]`` and the ``serve/warmup_programs``
+    telemetry counter."""
+    assert engine._thread is not None, "start() the engine before warmup"
+    short, long_ = engine._scale
+    t0 = time.perf_counter()
+    before = engine.counters["recompiles"]
+    for h, w in ((short, long_), (long_, short)):  # landscape, portrait
+        dummy = np.zeros((h, w, 3), np.uint8)
+        futs = [engine.submit(dummy, deadline_ms=0)  # never expire
+                for _ in range(engine.opts.batch_size)]
+        for f in futs:
+            f.result(timeout=600.0)
+    compiled = engine.counters["recompiles"] - before
+    engine.counters["warmup_programs"] += compiled
+    telemetry.get().counter("serve/warmup_programs", compiled)
+    logger.info("serve warmup: %d program(s) compiled in %.1fs "
+                "(batch=%d, scale=%s)", compiled,
+                time.perf_counter() - t0, engine.opts.batch_size,
+                engine._scale)
+    return compiled

@@ -31,6 +31,21 @@ RECOVERY_COUNTERS = (
     "checkpoint/retry",
 )
 
+# the serving subsystem's health counters (serve/engine.py): rendered as
+# their own section — zeros included — whenever the stream carries any
+# serve/* event, so "did the endpoint shed load, blow deadlines, or
+# recompile after warmup?" reads off one block (script/serve_smoke.sh
+# greps it the way fault_smoke.sh greps the recovery section)
+SERVE_COUNTERS = (
+    "serve/requests",
+    "serve/images",
+    "serve/batches",
+    "serve/rejected",
+    "serve/deadline_exceeded",
+    "serve/recompile",
+    "serve/warmup_programs",
+)
+
 
 def event_files(paths: Iterable[str]) -> List[str]:
     """Expand run dirs to their per-rank event files; pass files through."""
@@ -137,17 +152,26 @@ def render_table(summary: dict) -> str:
                          f"{s['mean_s'] * 1e3:>10.3f}"
                          f"{s['max_s'] * 1e3:>10.3f}")
     counters = summary.get("counters", {})
+    serving = any(k.startswith("serve/") for k in counters) or any(
+        k.startswith("serve/") for k in summary.get("spans", {}))
     if counters:
         lines.append("")
         lines.append(f"{'counter':<34}{'total':>8}")
         for name, v in counters.items():
             if name in RECOVERY_COUNTERS:
                 continue  # recovery events get their own section below
+            if serving and name in SERVE_COUNTERS:
+                continue  # ditto serve health
             lines.append(f"{name:<34}{v:>8}")
         lines.append("")
         lines.append(f"{'recovery event':<34}{'total':>8}")
         for name in RECOVERY_COUNTERS:
             lines.append(f"{name:<34}{counters.get(name, 0):>8}")
+        if serving:
+            lines.append("")
+            lines.append(f"{'serve health':<34}{'total':>8}")
+            for name in SERVE_COUNTERS:
+                lines.append(f"{name:<34}{counters.get(name, 0):>8}")
     gauges = summary.get("gauges", {})
     if gauges:
         lines.append("")

@@ -322,3 +322,18 @@ def load_eval_params(args, cfg: Config, model):
     mgr = CheckpointManager(args.prefix)
     params, _, _ = mgr.load_epoch(args.epoch, cfg, for_training=False)
     return params
+
+
+def eval_params_from_args(args, cfg: Config, model):
+    """Inference params for drivers that also run checkpoint-free
+    (serve.py smoke/CI): under ``--synthetic`` random-init params pushed
+    through the same de-normalize-at-save fold a real checkpoint carries
+    (the bench ``build_infer`` recipe — plumbing and layouts are real,
+    detections are noise); otherwise the checkpoint at
+    ``--prefix``/``--epoch``."""
+    if getattr(args, "synthetic", False):
+        from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
+
+        params = init_params(model, cfg, jax.random.PRNGKey(0), batch_size=1)
+        return denormalize_for_save(params, cfg)
+    return load_eval_params(args, cfg, model)

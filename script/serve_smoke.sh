@@ -1,0 +1,150 @@
+#!/usr/bin/env bash
+# Serving smoke (CPU-friendly): serve.py on synthetic weights + tiny
+# buckets, 32 mixed-size open-loop requests through scripts/loadgen.py,
+# then assert from the telemetry stream that (1) every response was 2xx,
+# (2) every XLA compile happened during warmup — zero steady-state
+# recompiles, the subsystem's core guarantee — and (3) p99 queue wait
+# stayed under the configured request deadline (head-of-line requests in
+# partial flushes legitimately wait the full --max-delay-ms, so the
+# deadline, not the delay, is the latency bound).
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${SERVE_SMOKE_DIR:-/tmp/mxr_serve_smoke}
+# sized for CPU CI: the tiny model serves ~2 imgs/s there, so a 4 req/s
+# open-loop burst of 32 builds a real backlog (the batcher runs full
+# batches) while staying far inside the deadline; on a real accelerator
+# the queue never builds at all
+deadline_ms=60000
+rm -rf "$dir"
+mkdir -p "$dir"
+sock="$dir/serve.sock"
+tel="$dir/telemetry"
+
+python serve.py --network resnet50 --synthetic --unix-socket "$sock" \
+  --serve-batch 2 --max-delay-ms 50 --max-queue 32 \
+  --deadline-ms "$deadline_ms" --telemetry-dir "$tel" \
+  --cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)" \
+  --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32 \
+  "$@" &
+pid=$!
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+
+# the socket binds only after warmup finishes compiling both buckets
+python - "$sock" "$pid" <<'EOF'
+import sys, time
+from mx_rcnn_tpu.serve import unix_http_request
+sock, pid = sys.argv[1], int(sys.argv[2])
+import os
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("serve.py exited before becoming healthy")
+    try:
+        status, doc = unix_http_request(sock, "GET", "/healthz", timeout=5)
+        if status == 200:
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1)
+sys.exit("serve.py never became healthy")
+EOF
+
+python scripts/loadgen.py --unix-socket "$sock" --n 32 --rate 4 \
+  --deadline-ms "$deadline_ms" --short 80 --long 110 --assert-2xx \
+  | tee "$dir/loadgen.json"
+
+# parity: an independent process rebuilds the server's exact synthetic
+# params (same PRNGKey recipe + cfg) and checks a served response against
+# the offline Predictor + shared-postprocess path on the same pixels
+python - "$sock" <<'EOF'
+import sys
+import jax
+import numpy as np
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import prepare_image
+from mx_rcnn_tpu.eval import Predictor
+from mx_rcnn_tpu.models import build_model, init_params
+from mx_rcnn_tpu.ops.postprocess import (decode_image_boxes,
+                                         detections_to_records,
+                                         per_class_nms)
+from mx_rcnn_tpu.serve import encode_image_payload, unix_http_request
+from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
+
+sock = sys.argv[1]
+cfg = generate_config(
+    "resnet50", "PascalVOC", tpu__SCALES=((96, 128),),
+    network__ANCHOR_SCALES=(2, 4),
+    # --synthetic sets this on the server (config_from_args); the offline
+    # replica must normalize pixels identically or scores diverge
+    network__PIXEL_STDS=(127.0, 127.0, 127.0),
+    TEST__RPN_PRE_NMS_TOP_N=300, TEST__RPN_POST_NMS_TOP_N=32)
+model = build_model(cfg)
+params = denormalize_for_save(
+    init_params(model, cfg, jax.random.PRNGKey(0), batch_size=1), cfg)
+pred = Predictor(model, params, cfg)
+img = np.random.RandomState(3).randint(0, 255, (80, 110, 3), dtype=np.uint8)
+status, resp = unix_http_request(sock, "POST", "/predict",
+                                 encode_image_payload(img), timeout=300)
+assert status == 200, resp
+B = 2  # --serve-batch: a lone request is self-padded to the full batch
+prepared, im_info = prepare_image(img, cfg, cfg.tpu.SCALES[0])
+rois, valid, scores, deltas, _ = [
+    np.asarray(jax.device_get(x)) for x in pred.predict(
+        np.stack([prepared] * B), np.stack([im_info] * B))]
+boxes = decode_image_boxes(rois[0], deltas[0], im_info)
+expect = detections_to_records(per_class_nms(
+    scores[0], boxes, valid[0], cfg.NUM_CLASSES, cfg.TEST.THRESH,
+    cfg.TEST.NMS, cfg.TEST.MAX_PER_IMAGE))
+got = resp["detections"]
+assert len(got) == len(expect), (len(got), len(expect))
+for d, e in zip(got, expect):
+    assert d["cls"] == e["cls"], (d, e)
+    assert abs(d["score"] - e["score"]) < 1e-4, (d, e)
+    assert np.allclose(d["bbox"], e["bbox"], atol=1e-2), (d, e)
+print(f"parity ok: {len(got)} detection(s) match the offline "
+      f"Predictor + shared-postprocess path")
+EOF
+
+# backpressure: an all-at-once burst beyond --max-queue must shed load
+# as fast 503s (never stall, never 5xx-other); accepted requests still
+# finish inside the deadline
+python scripts/loadgen.py --unix-socket "$sock" --n 48 --rate 0 \
+  --deadline-ms "$deadline_ms" --short 80 --long 110 \
+  | tee "$dir/loadgen_burst.json"
+python - "$dir/loadgen_burst.json" <<'EOF'
+import json, sys
+st = json.load(open(sys.argv[1]))["status"]
+assert set(st) <= {"200", "503"}, st
+assert st.get("200", 0) >= 1 and st.get("503", 0) >= 1, st
+print(f"backpressure ok: {st['200']} served, {st['503']} shed as 503")
+EOF
+
+kill -TERM "$pid"
+wait "$pid"
+trap - EXIT
+test -f "$tel/summary.json"
+
+python - "$tel" "$deadline_ms" <<'EOF'
+import sys
+import numpy as np
+from mx_rcnn_tpu.telemetry.report import aggregate, load_events
+events = load_events([sys.argv[1]])
+deadline_s = float(sys.argv[2]) / 1e3
+c = aggregate(events)["counters"]
+assert c["serve/recompile"] == c["serve/warmup_programs"], \
+    f"recompiled after warmup: {c}"
+# the burst phase must have shed load; the paced phase must not have
+# blown any deadline
+assert c.get("serve/rejected", 0) >= 1, c
+assert c.get("serve/deadline_exceeded", 0) == 0, c
+waits = [e["dur_s"] for e in events
+         if e.get("kind") == "span" and e.get("name") == "serve/queue_wait"]
+assert waits, "no serve/queue_wait spans in the stream"
+p99 = float(np.percentile(waits, 99))
+assert p99 <= deadline_s, f"p99 queue_wait {p99:.3f}s > {deadline_s}s deadline"
+print(f"serve smoke ok: {c['serve/recompile']} program(s), all from warmup; "
+      f"p99 queue_wait {p99 * 1e3:.1f} ms <= {deadline_s * 1e3:.0f} ms")
+EOF
+
+python scripts/telemetry_report.py "$tel" | grep -A 8 "serve health"

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Open-loop HTTP load generator for serve.py — latency under load.
+
+  python scripts/loadgen.py --host 127.0.0.1 --port 8321 --n 64 --rate 20
+  python scripts/loadgen.py --unix-socket /tmp/serve.sock --n 32 --rate 0
+
+Open-loop: request k is FIRED at its scheduled instant k/rate regardless
+of whether earlier responses came back (each request gets its own
+thread), so a slow server accumulates in-flight work and the latency
+distribution shows it — closed-loop generators that wait for responses
+throttle themselves to the server's pace and hide exactly the queueing
+behavior this exists to measure (the coordinated-omission trap).
+``--rate 0`` fires everything at once (burst mode: what backpressure
+tests want).
+
+Bodies are mixed-size random uint8 images — half landscape, half
+portrait, dimensions jittered per request (seeded) — so the server
+exercises both orientation buckets and real ``resize_to_bucket`` work.
+
+Prints exactly ONE JSON line:
+
+  {"requests": N, "status": {"200": k, "503": m, ...}, "p50_ms": ...,
+   "p99_ms": ..., "mean_queue_wait_ms": ..., "imgs_per_sec": ...,
+   "wall_s": ...}
+
+latency percentiles are over 2xx responses (client-observed, including
+queue wait + forward + post-process + transport); ``imgs_per_sec`` is
+2xx responses over the wall from first fire to last response.  With
+``--assert-2xx`` the exit code is 1 unless every response was 2xx —
+what script/serve_smoke.sh runs.  Pure stdlib + numpy; no jax import,
+safe on a machine with no accelerator.
+"""
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mx_rcnn_tpu.serve.frontend import (encode_image_payload,  # noqa: E402
+                                        unix_http_request)
+
+
+def parse_args():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--unix-socket", default="", dest="unix_socket",
+                    help="target a Unix-socket server instead of TCP")
+    ap.add_argument("--n", type=int, default=32, help="requests to fire")
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="arrival rate, req/s (0 = fire all at once)")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    dest="deadline_ms",
+                    help="per-request deadline forwarded to the server "
+                         "(0 = server default)")
+    ap.add_argument("--short", type=int, default=480,
+                    help="short side of generated images (long side is "
+                         "--long); pick at or under the server's bucket "
+                         "scale")
+    ap.add_argument("--long", type=int, default=640, dest="long_")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-request client wait")
+    ap.add_argument("--assert-2xx", action="store_true", dest="assert_2xx",
+                    help="exit 1 unless every response was 2xx")
+    return ap.parse_args()
+
+
+def make_payloads(args):
+    rng = np.random.RandomState(args.seed)
+    docs = []
+    for i in range(args.n):
+        h, w = ((args.short, args.long_) if i % 2 == 0
+                else (args.long_, args.short))
+        dh, dw = rng.randint(0, max(min(h, w) // 4, 1), 2)
+        img = rng.randint(0, 255, (max(h - dh, 16), max(w - dw, 16), 3),
+                          dtype=np.uint8)
+        doc = encode_image_payload(img)
+        if args.deadline_ms > 0:
+            doc["deadline_ms"] = args.deadline_ms
+        docs.append(doc)
+    return docs
+
+
+def tcp_request(host, port, doc, timeout):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/predict", body=json.dumps(doc).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def main():
+    args = parse_args()
+    if bool(args.unix_socket) == bool(args.port):
+        raise SystemExit("pass exactly one of --port / --unix-socket")
+    docs = make_payloads(args)
+
+    results = [None] * args.n  # (status, latency_s, queue_wait_ms)
+
+    def fire(i):
+        t0 = time.perf_counter()
+        try:
+            if args.unix_socket:
+                status, resp = unix_http_request(
+                    args.unix_socket, "POST", "/predict", docs[i],
+                    timeout=args.timeout)
+            else:
+                status, resp = tcp_request(args.host, args.port, docs[i],
+                                           args.timeout)
+        except Exception as e:  # noqa: BLE001 — a dead server is a result
+            results[i] = (0, time.perf_counter() - t0, None,
+                          f"{type(e).__name__}: {e}")
+            return
+        results[i] = (status, time.perf_counter() - t0,
+                      resp.get("queue_wait_ms"), None)
+
+    t_start = time.perf_counter()
+    threads = []
+    for i in range(args.n):
+        if args.rate > 0:  # open loop: fire on the clock, never on replies
+            lag = t_start + i / args.rate - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+        th = threading.Thread(target=fire, args=(i,))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t_start
+
+    status_counts = {}
+    for st, _, _, _ in results:
+        status_counts[str(st)] = status_counts.get(str(st), 0) + 1
+    ok = [r for r in results if 200 <= r[0] < 300]
+    lat_ms = np.asarray([r[1] for r in ok]) * 1e3
+    qw = [r[2] for r in ok if r[2] is not None]
+    out = {
+        "requests": args.n,
+        "status": dict(sorted(status_counts.items())),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3) if ok else None,
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3) if ok else None,
+        "mean_queue_wait_ms": (round(float(np.mean(qw)), 3) if qw else None),
+        "imgs_per_sec": round(len(ok) / wall, 3),
+        "wall_s": round(wall, 3),
+    }
+    errors = sorted({r[3] for r in results if r[3]})
+    if errors:
+        out["errors"] = errors[:5]
+    print(json.dumps(out))
+    if args.assert_2xx and len(ok) != args.n:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

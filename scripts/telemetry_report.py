@@ -16,7 +16,10 @@ machine with no accelerator.
 The table includes a "recovery event" section (loader/bad_record,
 train/nan_*, train/preempted, checkpoint/retry — zeros included) so
 fault-tolerance triage reads off one block; script/fault_smoke.sh
-asserts on it.
+asserts on it.  Streams from a serving run (serve.py / bench.py --mode
+serve) additionally get a "serve health" section — requests/batches plus
+the rejection, deadline-exceeded, and post-warmup recompile counters,
+zeros included — which script/serve_smoke.sh asserts on the same way.
 """
 
 import argparse

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Online serving driver: checkpoint → warmed ServeEngine → HTTP frontend.
+
+The online counterpart of test.py's offline loop (ROADMAP north star:
+"serves heavy traffic"): load a checkpoint (or ``--synthetic`` random
+weights for smoke/CI), pre-compile every (bucket, batch) program, then
+serve ``/predict`` with bucket-aware dynamic batching until SIGTERM/SIGINT.
+
+    # smoke: synthetic weights, tiny buckets, TCP on 8321
+    python serve.py --network resnet50 --synthetic --port 8321 \
+        --cfg "tpu__SCALES=((96,128),)" --serve-batch 4 --max-delay-ms 20
+
+    # production-shaped: real checkpoint, telemetry on
+    python serve.py --network resnet101 --prefix model/e2e --epoch 10 \
+        --port 8321 --serve-batch 8 --max-delay-ms 10 --telemetry-dir /tmp/t
+
+Scale-out contract: one replica per host/chip set behind a load balancer
+(the Predictor is single-controller by design — see its multiprocess
+error); ``--max-queue`` bounds each replica's admission so overload
+sheds as fast 503s the balancer can retry elsewhere, not as queue bloat.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.eval import Predictor
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.serve import ServeEngine, ServeOptions, make_server, warmup
+from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
+                                      eval_params_from_args)
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="Serve a Faster R-CNN network over HTTP")
+    add_common_args(parser, train=False)
+    parser.add_argument("--port", type=int, default=0,
+                        help="TCP port for the HTTP frontend")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--unix-socket", default="", dest="unix_socket",
+                        help="serve HTTP over this Unix socket instead of "
+                             "TCP (tests, local sidecars)")
+    parser.add_argument("--serve-batch", type=int, default=4,
+                        dest="serve_batch",
+                        help="images per forward — every batch is padded "
+                             "to exactly this size (one program per "
+                             "bucket)")
+    parser.add_argument("--max-delay-ms", type=float, default=10.0,
+                        dest="max_delay_ms",
+                        help="flush a partial batch once its oldest "
+                             "request has waited this long; THE latency/"
+                             "throughput knob (0 = no coalescing wait)")
+    parser.add_argument("--max-queue", type=int, default=64,
+                        dest="max_queue",
+                        help="bounded-queue backpressure: submits beyond "
+                             "this many pending requests get 503")
+    parser.add_argument("--deadline-ms", type=float, default=30000.0,
+                        dest="deadline_ms",
+                        help="default per-request deadline (504 when "
+                             "exceeded; requests may override; <=0 "
+                             "disables)")
+    return parser.parse_args()
+
+
+def main(args):
+    if not args.unix_socket and not args.port:
+        raise SystemExit("pass --port or --unix-socket")
+    cfg = config_from_args(args, train=False)
+    model = build_model(cfg)
+    params = eval_params_from_args(args, cfg, model)
+    if args.telemetry_dir:
+        telemetry.configure(args.telemetry_dir,
+                            run_meta={"driver": "serve",
+                                      "network": args.network,
+                                      "serve_batch": args.serve_batch,
+                                      "max_delay_ms": args.max_delay_ms})
+    predictor = Predictor(model, params, cfg)
+    engine = ServeEngine(predictor, cfg, ServeOptions(
+        batch_size=args.serve_batch, max_delay_ms=args.max_delay_ms,
+        max_queue=args.max_queue, deadline_ms=args.deadline_ms)).start()
+    warmup(engine)
+
+    server = make_server(engine, port=args.port or None, host=args.host,
+                         unix_socket=args.unix_socket or None)
+    # serve_forever on a worker thread; the main thread parks on an event
+    # the signal handlers set — shutdown() called from the serving thread
+    # itself would deadlock its poll loop
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    t = threading.Thread(target=server.serve_forever, name="serve-http",
+                         daemon=True)
+    t.start()
+    where = args.unix_socket or f"http://{args.host}:{args.port}"
+    logger.info("serving %s on %s (batch=%d, max_delay=%.0fms, "
+                "max_queue=%d)", args.network, where, args.serve_batch,
+                args.max_delay_ms, args.max_queue)
+    done.wait()
+    logger.info("shutting down: %s", engine.metrics()["counters"])
+    server.shutdown()
+    engine.stop()
+    if args.telemetry_dir:
+        telemetry.get().write_summary(extra={"serve": engine.metrics()})
+        telemetry.shutdown()
+
+
+if __name__ == "__main__":
+    main(parse_args())
