@@ -243,6 +243,13 @@ class TPUConfig:
     # of the training loop hanging forever on a stuck filesystem read
     # (<= 0 disables)
     PREFETCH_WATCHDOG_S: float = 600.0
+    # host input pipeline worker processes (data/workers.py): 0 (default)
+    # keeps the single-thread producer, bit-identical to before the pool
+    # existed; N > 0 fans the per-sample decode/resize/flip hot path over
+    # N processes with shared-memory handover — same batches, same order,
+    # any seed (the epoch plan is drawn once on the consumer and sharded
+    # by index)
+    LOADER_WORKERS: int = 0
     # rematerialize the backbone stages in the backward pass
     # (nn.remat on each ResNetStage): trades recompute FLOPs for HBM
     # traffic — the B>=16 lever for the measured relu-backward

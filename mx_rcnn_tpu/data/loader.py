@@ -135,6 +135,27 @@ def _stack(samples: List[dict]) -> Dict[str, np.ndarray]:
     return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
 
 
+def _iter_samples(roidb: list, cfg: Config, plan, part_fn, pool,
+                  with_masks: bool = False) -> Iterator[Tuple[int, dict]]:
+    """Yield ``(actual_index, sample)`` for every row this process owns,
+    in plan order — through the multi-worker ``pool`` when one is set, on
+    the calling (producer) thread otherwise.  Both paths run the same
+    ``_load_record_isolated`` per task, so the output stream is identical
+    sample for sample; only the consecutive-bad-record budget is scoped
+    differently (per epoch serially, per worker with a pool — either way
+    ``MAX_CONSECUTIVE_BAD_RECORDS`` failures in a row on one producer is
+    systemic and raises)."""
+    tasks = [(int(i), scale) for chunk, scale in plan
+             for i in part_fn(chunk)]
+    if pool is not None:
+        yield from pool.imap_records(tasks, with_masks=with_masks)
+        return
+    fail_state = [0]
+    for i, scale in tasks:
+        yield _load_record_isolated(roidb, i, cfg, scale,
+                                    with_masks=with_masks, state=fail_state)
+
+
 class _Prefetcher:
     """Runs a batch-producing generator in a daemon thread with a bounded
     queue (depth = cfg.tpu.PREFETCH).  Closing (or GC of) the iterator stops
@@ -331,6 +352,10 @@ class AnchorLoader:
         # just like the k=1 ``put`` path (round-4 weakness 2: consumer-side
         # stacking shipped each group synchronously)
         self.wrap = None
+        # multi-worker host pipeline (cfg.tpu.LOADER_WORKERS > 0): created
+        # lazily on first iteration, REUSED across epochs (the shm ring is
+        # allocated once), torn down by close_workers()/GC
+        self._pool = None
         self._rng = np.random.RandomState(seed)
         self._skip = 0  # one-shot batch skip armed by skip_next()
         # aspect grouping: horizontal (w>=h) vs vertical image index pools
@@ -428,16 +453,45 @@ class AnchorLoader:
         bl = self.batch_size // self.num_parts
         return chunk[self.part_index * bl:(self.part_index + 1) * bl]
 
+    def _ensure_pool(self):
+        """Create the worker pool on first use (consumer thread — forking
+        from the prefetch producer thread would snapshot mid-mutation
+        state).  workers=0 (the default) keeps today's serial producer,
+        bit for bit."""
+        workers = int(getattr(self.cfg.tpu, "LOADER_WORKERS", 0))
+        if workers > 0 and self._pool is None:
+            from mx_rcnn_tpu.data.workers import WorkerPool
+
+            self._pool = WorkerPool(self.cfg, self.roidb,
+                                    num_workers=workers)
+        return self._pool
+
+    def close_workers(self):
+        """Tear down the worker pool (processes + shm segment).  Idempotent;
+        the next iteration recreates it."""
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close_workers()
+        except Exception:
+            pass
+
     def _produce(self, plan) -> Iterator[Dict[str, np.ndarray]]:
-        fail_state = [0]  # consecutive bad records, across the whole epoch
-        for chunk, scale in plan:
-            yield _stack([_load_record_isolated(self.roidb, int(i), self.cfg,
-                                                scale, with_masks=True,
-                                                state=fail_state)[1]
-                          for i in self._part(chunk)])
+        bl = self.batch_size // self.num_parts
+        samples: List[dict] = []
+        for _, s in _iter_samples(self.roidb, self.cfg, plan, self._part,
+                                  self._pool, with_masks=True):
+            samples.append(s)
+            if len(samples) == bl:
+                yield _stack(samples)
+                samples = []
 
     def __iter__(self):
         plan = self._take_epoch_plan()  # RNG on the consumer thread only
+        self._ensure_pool()
         gen = self._produce(plan)
         if self.wrap is not None:
             gen = self.wrap(gen)
@@ -530,6 +584,9 @@ class ROIIter:
     def skip_next(self, n: int) -> None:
         self._inner.skip_next(n)
 
+    def close_workers(self):
+        self._inner.close_workers()
+
     def __iter__(self):
         cfg = self.cfg
         p_max = cfg.TRAIN.RPN_POST_NMS_TOP_N
@@ -537,31 +594,34 @@ class ROIIter:
         # TRAIN.SCALES in the Fast-RCNN path too); proposals are in the
         # original image frame and rescale by each batch's own im_scale
         plan = self._inner._take_epoch_plan()
+        pool = self._inner._ensure_pool()
         roidb = self._inner.roidb
+        bl = self.batch_size // self.num_parts
 
         def produce():
-            fail_state = [0]
-            for chunk, scale in plan:
-                samples = []
-                for i in self._inner._part(chunk):
-                    # the substituted index pairs the sample with ITS OWN
-                    # proposals — mixing record j's pixels with record i's
-                    # rois would train on garbage
-                    j, s = _load_record_isolated(roidb, int(i), cfg, scale,
-                                                 state=fail_state)
-                    rec = roidb[j]
-                    props = np.asarray(rec.get("proposals",
-                                               np.zeros((0, 4))), np.float32)
-                    rois = np.zeros((p_max, 4), np.float32)
-                    rvalid = np.zeros((p_max,), bool)
-                    n = min(len(props), p_max)
-                    if n:
-                        rois[:n] = props[:n] * s["im_info"][2]
-                        rvalid[:n] = True
-                    s["rois"] = rois
-                    s["roi_valid"] = rvalid
-                    samples.append(s)
-                yield _stack(samples)
+            samples = []
+            for j, s in _iter_samples(roidb, cfg, plan, self._inner._part,
+                                      pool):
+                # the substituted index pairs the sample with ITS OWN
+                # proposals — mixing record j's pixels with record i's
+                # rois would train on garbage.  Proposal attach stays in
+                # the parent (workers ship pixels + gt only; proposal
+                # arrays live in the parent's roidb either way)
+                rec = roidb[j]
+                props = np.asarray(rec.get("proposals",
+                                           np.zeros((0, 4))), np.float32)
+                rois = np.zeros((p_max, 4), np.float32)
+                rvalid = np.zeros((p_max,), bool)
+                n = min(len(props), p_max)
+                if n:
+                    rois[:n] = props[:n] * s["im_info"][2]
+                    rvalid[:n] = True
+                s["rois"] = rois
+                s["roi_valid"] = rvalid
+                samples.append(s)
+                if len(samples) == bl:
+                    yield _stack(samples)
+                    samples = []
 
         gen = produce()
         if self.wrap is not None:

@@ -1,0 +1,507 @@
+"""Multi-worker host input pipeline: process pool + shared-memory handover.
+
+The device step is heavily optimized (chain dispatch, donated state, bf16,
+host s2d), but every pixel still used to be decoded/resized/normalized by
+ONE Python producer thread (``loader._Prefetcher``) — the ``loader_wait``
+the PR-1 telemetry exposes.  This module scales that hot path across
+``cfg.tpu.LOADER_WORKERS`` OS processes, the same producer/consumer
+decoupling tf.data and PyTorch's multi-worker DataLoader exist for:
+
+* Each worker runs the per-sample hot path (``_load_record_isolated`` /
+  ``prepare_image``: imread, resize, normalize, flip, bucket pad, host
+  s2d) and writes the finished pixel array into a preallocated
+  ``multiprocessing.shared_memory`` ring slot — ZERO pickle copies for
+  pixel data; only small metadata (im_info, gt targets, shapes) crosses
+  the result queue.
+* The parent's order-preserving collector hands samples back IN TASK
+  ORDER regardless of worker skew, so batches assemble exactly as the
+  serial producer would have built them and the existing prefetch queue /
+  ``device_put`` double-buffering hooks run unchanged downstream.
+
+Determinism is load-bearing: all RNG (shuffle, scale choice, wrap
+padding, flip plan) stays in the loader's seeded epoch plan on the
+consumer side; workers are pure functions of (roidb index, scale).  Tasks
+are sharded to workers by sequence number (``seq % N``), so the schedule
+— and therefore ``advance_epochs``/``skip_next`` exact mid-epoch resume —
+is identical with workers on or off, batch for batch.
+
+Fault isolation mirrors the PR-2 bad-record contract: a crashed worker
+(segfault, OOM-kill) is respawned with a fresh task queue and its
+in-flight tasks reissued (``loader/worker_respawn`` counter); crossing
+``MAX_WORKER_RESPAWNS`` marks the pool broken and raises — systemic
+breakage must not silently grind on respawns.  Bad records inside a
+worker keep the per-producer consecutive-failure budget and surface the
+same systemic RuntimeError through the result queue.
+
+Telemetry (active sink only): ``loader/assembly_wait`` (collector blocked
+on the next in-order sample = workers are the bottleneck),
+``loader/worker_busy`` (fraction of workers with work in flight),
+per-worker ``loader/worker{N}/produce`` spans (skew triage), and the
+``loader/bad_record`` / ``loader/worker_respawn`` recovery counters.
+``scripts/telemetry_report.py`` folds all of these.
+
+The serve engine reuses the same pool for ``prepare_image`` (the caller-
+thread resize is the serving ingest bottleneck at high offered load):
+``prepare()`` ships the raw image in by pickle (small, uint8) and the
+prepared float32 bucket array back through the shm ring.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import threading
+import time
+import traceback
+from multiprocessing import shared_memory
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.logger import logger
+
+# Total respawns a pool tolerates before declaring the breakage systemic
+# (a worker that dies on every task would otherwise respawn forever).
+# Module-level so tests/operators can widen it — the MAX_CONSECUTIVE_BAD_
+# RECORDS recipe.
+MAX_WORKER_RESPAWNS = 8
+
+# Fault injection (tests / script smoke): crash a worker with os._exit(3)
+# when it is asked to load this roidb index...
+_ENV_CRASH_IDX = "MXR_FAULT_WORKER_CRASH_IDX"
+# ...unless this marker file already exists (created atomically by the
+# first crash) — "crash exactly once", the respawn-recovers case.
+_ENV_CRASH_ONCE = "MXR_FAULT_WORKER_CRASH_ONCE"
+# "worker_id:seconds" — that worker sleeps per task (slow-worker skew).
+_ENV_SLOW = "MXR_FAULT_WORKER_SLOW"
+
+
+def _mp_context():
+    """fork where available (Linux: no re-import, roidb shared COW),
+    overridable via MXR_LOADER_MP_START for spawn-only platforms."""
+    import multiprocessing as mp
+
+    method = os.environ.get("MXR_LOADER_MP_START")
+    if not method:
+        method = ("fork" if "fork" in mp.get_all_start_methods()
+                  else "spawn")
+    return mp.get_context(method)
+
+
+def slot_bytes_for(cfg) -> int:
+    """Ring-slot size: the largest single prepared sample the config can
+    emit — max over scale buckets of H*W*3 float32 bytes (host s2d
+    regroups channels but conserves the element count, and portrait/
+    landscape buckets have equal area)."""
+    from mx_rcnn_tpu.data.image import bucket_shape
+
+    stride = max(cfg.network.IMAGE_STRIDE, cfg.network.RPN_FEAT_STRIDE)
+    best = 0
+    for scale in cfg.tpu.SCALES:
+        hb, wb = bucket_shape(scale, stride, landscape=True)
+        best = max(best, hb * wb * 3 * 4)
+    return best
+
+
+def _attach_shm(name: str) -> shared_memory.SharedMemory:
+    """Attach to the pool's segment WITHOUT registering it with this
+    process's resource tracker: on 3.10 every attach registers for
+    unlink-at-exit, so a worker exiting would tear the segment down (or
+    at least warn) under the parent still using it (bpo-39959; fixed by
+    track=False in 3.13)."""
+    try:
+        from multiprocessing import resource_tracker
+
+        orig = resource_tracker.register
+        resource_tracker.register = lambda *a, **k: None
+    except Exception:  # pragma: no cover — tracker API is CPython detail
+        return shared_memory.SharedMemory(name=name)
+    try:
+        return shared_memory.SharedMemory(name=name)
+    finally:
+        resource_tracker.register = orig
+
+
+def _maybe_crash(index: int):
+    """Env-driven hard-crash injection (see module constants)."""
+    want = os.environ.get(_ENV_CRASH_IDX)
+    if want is None or int(want) != int(index):
+        return
+    marker = os.environ.get(_ENV_CRASH_ONCE)
+    if marker:
+        try:  # atomic create: exactly one crash across all workers
+            fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+        except FileExistsError:
+            return
+    os._exit(3)
+
+
+def _worker_main(worker_id: int, cfg, roidb, shm_name: str, slot_bytes: int,
+                 task_q, result_q):
+    """One decode/augment worker.  Pure consumer of task messages
+    ``(seq, kind, payload, scale, with_masks, slot)``:
+
+    * kind "record": payload is a roidb index → ``_load_record_isolated``
+      (bad-record substitution included), pixels into the shm slot,
+      metadata (actual index, gt targets, im_info, produce span) back.
+    * kind "image": payload is a raw RGB array (serving ingest) →
+      ``prepare_image``, pixels into the slot, im_info back.
+
+    None is the shutdown sentinel.
+    """
+    import signal
+
+    # the parent handles SIGINT for everyone (a Ctrl-C must not kill the
+    # workers before the parent decides whether to checkpoint), and a
+    # forked child must not run the parent's preemption handlers
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+    try:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    except (OSError, ValueError):  # pragma: no cover
+        pass
+    try:
+        import cv2
+
+        cv2.setNumThreads(0)  # N workers × cv2's own pool oversubscribes
+    except Exception:
+        pass
+    # a fork inherits the parent's open telemetry stream — a worker
+    # writing (or closing) it would interleave garbage into the JSONL
+    telemetry.reset_null()
+
+    from mx_rcnn_tpu.data import loader as loader_mod
+
+    shm = _attach_shm(shm_name)
+    fail_state = [0]  # consecutive bad records, per worker (PR-2 budget)
+    slow_s = 0.0
+    slow = os.environ.get(_ENV_SLOW)
+    if slow:
+        wid, _, sec = slow.partition(":")
+        if int(wid) == worker_id:
+            slow_s = float(sec)
+    try:
+        while True:
+            msg = task_q.get()
+            if msg is None:
+                return
+            seq, kind, payload, scale, with_masks, slot = msg
+            t0 = time.perf_counter()
+            try:
+                if slow_s:
+                    time.sleep(slow_s)
+                if kind == "record":
+                    index = int(payload)
+                    _maybe_crash(index)
+                    j, sample = loader_mod._load_record_isolated(
+                        roidb, index, cfg, scale, with_masks=with_masks,
+                        state=fail_state)
+                    img = sample.pop("images")
+                    meta = {"index": j, "sample": sample,
+                            "bad": (j - index) % len(roidb)}
+                else:  # "image" (serving ingest)
+                    img, im_info = loader_mod.prepare_image(
+                        np.asarray(payload), cfg, scale)
+                    meta = {"im_info": im_info, "bad": 0}
+                view = np.ndarray(
+                    img.shape, img.dtype,
+                    buffer=shm.buf[slot * slot_bytes:
+                                   slot * slot_bytes + img.nbytes])
+                view[...] = img
+                meta["shape"] = tuple(img.shape)
+                meta["dtype"] = img.dtype.str
+                meta["dur_s"] = time.perf_counter() - t0
+                result_q.put(("ok", seq, worker_id, meta))
+            except BaseException as e:  # surfaced at the collector
+                result_q.put(("err", seq, worker_id,
+                              f"{type(e).__name__}: {e}\n"
+                              f"{traceback.format_exc()}"))
+    finally:
+        shm.close()
+
+
+class _Pending:
+    __slots__ = ("worker", "msg", "done", "meta", "error")
+
+    def __init__(self, worker: int, msg: tuple):
+        self.worker = worker
+        self.msg = msg
+        self.done = False
+        self.meta = None
+        self.error: Optional[str] = None
+
+
+class WorkerPool:
+    """``num_workers`` decode/augment processes over one shared-memory
+    slot ring.  One pool per loader (or serve engine); epochs REUSE the
+    pool — slots cycle, the segment is allocated exactly once and
+    unlinked at ``close()``.
+
+    ``roidb`` may be None for image-only pools (serving)."""
+
+    def __init__(self, cfg, roidb: Optional[list] = None,
+                 num_workers: int = 1, n_slots: Optional[int] = None,
+                 max_respawns: Optional[int] = None):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        self.cfg = cfg
+        self.roidb = roidb
+        self.num_workers = int(num_workers)
+        self.slot_bytes = slot_bytes_for(cfg)
+        # in-flight window: enough for every worker to be busy with one
+        # task and have the next queued, plus headroom for out-of-order
+        # completions parked at the collector
+        self.n_slots = int(n_slots) if n_slots else max(
+            2 * self.num_workers + 2, 4)
+        self.max_respawns = (MAX_WORKER_RESPAWNS if max_respawns is None
+                             else int(max_respawns))
+        self._ctx = _mp_context()
+        self._shm = shared_memory.SharedMemory(
+            create=True, size=self.n_slots * self.slot_bytes)
+        self._result_q = self._ctx.Queue()
+        self._task_qs = [self._ctx.Queue() for _ in range(self.num_workers)]
+        self._free: queue.Queue = queue.Queue()
+        for s in range(self.n_slots):
+            self._free.put(s)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: dict = {}  # seq -> _Pending
+        self._seq = 0
+        self._closed = False
+        self._broken: Optional[BaseException] = None
+        self.respawns = 0
+        self._procs = [self._spawn(w) for w in range(self.num_workers)]
+        self._collector = threading.Thread(target=self._collect_loop,
+                                           name="loader-pool-collector",
+                                           daemon=True)
+        self._collector.start()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def _spawn(self, worker_id: int):
+        p = self._ctx.Process(
+            target=_worker_main,
+            args=(worker_id, self.cfg, self.roidb, self._shm.name,
+                  self.slot_bytes, self._task_qs[worker_id], self._result_q),
+            name=f"loader-worker-{worker_id}", daemon=True)
+        p.start()
+        return p
+
+    def close(self, timeout: float = 5.0):
+        """Stop workers, join the collector, free the shm segment.
+        Idempotent; safe from ``__del__``."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        for q_ in self._task_qs:
+            try:
+                q_.put(None)
+            except (ValueError, OSError):
+                pass
+        for p in self._procs:
+            p.join(timeout=timeout)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=1.0)
+        if self._collector.is_alive():
+            self._collector.join(timeout=timeout)
+        for q_ in self._task_qs + [self._result_q]:
+            try:
+                q_.close()
+                q_.cancel_join_thread()
+            except (ValueError, OSError):
+                pass
+        try:
+            self._shm.close()
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    def __del__(self):  # no join storms in GC — close() bounds every wait
+        try:
+            self.close(timeout=1.0)
+        except Exception:
+            pass
+
+    # -- submission / collection ----------------------------------------
+
+    def _take_slot(self) -> int:
+        while True:
+            try:
+                return self._free.get(timeout=0.2)
+            except queue.Empty:
+                with self._lock:
+                    if self._broken is not None:
+                        raise RuntimeError(str(self._broken))
+                    if self._closed:
+                        raise RuntimeError("worker pool closed")
+
+    def _submit(self, kind: str, payload, scale, with_masks: bool) -> int:
+        slot = self._take_slot()  # blocks: bounds in-flight to n_slots
+        with self._cond:
+            if self._broken is not None:
+                self._free.put(slot)
+                raise RuntimeError(str(self._broken))
+            if self._closed:
+                self._free.put(slot)
+                raise RuntimeError("worker pool closed")
+            seq = self._seq
+            self._seq += 1
+            # deterministic shard-by-index: the same plan always lands on
+            # the same workers, so worker-local state (bad-record budget)
+            # and failure attribution are reproducible
+            w = seq % self.num_workers
+            msg = (seq, kind, payload, tuple(scale), bool(with_masks), slot)
+            self._pending[seq] = _Pending(w, msg)
+        self._task_qs[w].put(msg)
+        return seq
+
+    def _wait(self, seq: int) -> Tuple[np.ndarray, dict]:
+        """Block for ticket ``seq``; copy its pixels out of the ring slot,
+        recycle the slot, return (pixels, metadata)."""
+        tel = telemetry.get()
+        t0 = time.perf_counter()
+        with self._cond:
+            while True:
+                t = self._pending.get(seq)
+                if t is None:
+                    raise RuntimeError(f"unknown pool ticket {seq}")
+                if t.done:
+                    del self._pending[seq]
+                    break
+                if self._broken is not None:
+                    raise RuntimeError(str(self._broken))
+                if not self._cond.wait(timeout=0.5):
+                    self._check_workers_locked()
+            if tel.enabled:
+                in_flight = {p.worker for p in self._pending.values()
+                             if not p.done}
+                tel.gauge("loader/worker_busy",
+                          len(in_flight) / self.num_workers)
+        slot = t.msg[5]
+        if t.error is not None:
+            self._free.put(slot)
+            raise RuntimeError(
+                f"loader worker {t.worker} task failed: {t.error}")
+        meta = t.meta
+        view = np.ndarray(
+            meta["shape"], np.dtype(meta["dtype"]),
+            buffer=self._shm.buf[slot * self.slot_bytes:
+                                 slot * self.slot_bytes + self.slot_bytes])
+        img = np.array(view, copy=True)  # slot freed below — must own
+        self._free.put(slot)
+        if tel.enabled:
+            tel.add("loader/assembly_wait", time.perf_counter() - t0)
+            tel.add(f"loader/worker{t.worker}/produce", meta["dur_s"])
+            if meta.get("bad"):
+                tel.counter("loader/bad_record", meta["bad"])
+        return img, meta
+
+    def _collect_loop(self):
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+            try:
+                status, seq, worker_id, payload = self._result_q.get(
+                    timeout=0.2)
+            except queue.Empty:
+                with self._cond:
+                    self._check_workers_locked()
+                continue
+            except (ValueError, OSError):  # queue closed mid-shutdown
+                return
+            with self._cond:
+                t = self._pending.get(seq)
+                if t is None or t.done:
+                    continue  # stale (reissued task raced its original)
+                t.done = True
+                if status == "ok":
+                    t.meta = payload
+                else:
+                    t.error = payload
+                self._cond.notify_all()
+
+    def _check_workers_locked(self):
+        """Respawn dead workers and reissue their in-flight tasks (fresh
+        task queue — the dead worker's queue may still hold unread tasks,
+        and reissuing into it would duplicate seqs).  Called under the
+        condition lock from both the collector and blocked waiters."""
+        if self._closed or self._broken is not None:
+            return
+        for w, p in enumerate(self._procs):
+            if p.is_alive():
+                continue
+            lost = sorted(s for s, t in self._pending.items()
+                          if t.worker == w and not t.done)
+            self.respawns += 1
+            telemetry.get().counter("loader/worker_respawn")
+            if self.respawns > self.max_respawns:
+                err = RuntimeError(
+                    f"loader worker {w} died (exit {p.exitcode}) and the "
+                    f"pool exceeded {self.max_respawns} respawns — this "
+                    f"looks systemic (OOM-killed decode? poisoned "
+                    f"record crashing native code?), not a stray fault")
+                self._broken = err
+                for s in lost:
+                    self._pending[s].done = True
+                    self._pending[s].error = str(err)
+                self._cond.notify_all()
+                return
+            logger.warning(
+                "loader worker %d died (exit %s) — respawning, reissuing "
+                "%d in-flight task(s) [loader/worker_respawn]",
+                w, p.exitcode, len(lost))
+            self._task_qs[w] = self._ctx.Queue()
+            self._procs[w] = self._spawn(w)
+            for s in lost:
+                self._task_qs[w].put(self._pending[s].msg)
+
+    # -- high-level APIs -------------------------------------------------
+
+    def imap_records(self, tasks: Iterable[Tuple[int, tuple]],
+                     with_masks: bool = False):
+        """Ordered map over ``(roidb_index, scale)`` tasks: yields
+        ``(actual_index, sample)`` — the ``_load_record_isolated``
+        contract — IN TASK ORDER, keeping up to ``n_slots`` tasks in
+        flight.  Out-of-order completions park at the collector; the
+        oldest outstanding task is always either queued on, or being run
+        by, its (deterministically assigned) worker, so order-preserving
+        assembly cannot deadlock."""
+        tasks = list(tasks)
+        tickets: collections.deque = collections.deque()
+        i = 0
+        try:
+            while tickets or i < len(tasks):
+                while i < len(tasks) and len(tickets) < self.n_slots:
+                    idx, scale = tasks[i]
+                    tickets.append(
+                        self._submit("record", int(idx), scale, with_masks))
+                    i += 1
+                img, meta = self._wait(tickets.popleft())
+                sample = meta["sample"]
+                sample["images"] = img
+                yield meta["index"], sample
+        finally:
+            # abandoned mid-epoch (consumer closed the prefetcher): drain
+            # outstanding tickets so their ring slots return to the free
+            # list — the pool outlives the epoch and must not bleed slots
+            while tickets:
+                try:
+                    self._wait(tickets.popleft())
+                except Exception:
+                    pass
+
+    def prepare(self, image: np.ndarray, scale) -> Tuple[np.ndarray,
+                                                         np.ndarray]:
+        """Serving ingest: run ``data.prepare_image`` in a worker process
+        (raw uint8 in via the task queue, prepared float32 back through
+        the shm ring).  Thread-safe; blocks the calling thread only."""
+        seq = self._submit("image", np.ascontiguousarray(image), scale,
+                           False)
+        img, meta = self._wait(seq)
+        return img, meta["im_info"]

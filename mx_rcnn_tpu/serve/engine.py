@@ -80,6 +80,11 @@ class ServeOptions:
     max_queue: int = 64
     # default per-request deadline (<= 0 disables); requests may override
     deadline_ms: float = 30000.0
+    # host prep worker processes (data/workers.py shm pool, CLI
+    # --loader-workers): 0 keeps prepare_image on each caller's thread;
+    # N > 0 ships it to the shared pool — the serving ingest bottleneck
+    # once offered load outruns one interpreter's resize throughput
+    prep_workers: int = 0
 
     def __post_init__(self):
         if self.batch_size < 1:
@@ -88,6 +93,9 @@ class ServeOptions:
             raise ValueError(
                 f"max_queue ({self.max_queue}) must be >= batch_size "
                 f"({self.batch_size}) or a full batch could never queue")
+        if self.prep_workers < 0:
+            raise ValueError(
+                f"prep_workers must be >= 0, got {self.prep_workers}")
 
 
 class ServeFuture:
@@ -160,17 +168,28 @@ class ServeEngine:
         self.counters = {"requests": 0, "served": 0, "batches": 0,
                          "rejected": 0, "deadline_exceeded": 0,
                          "recompiles": 0, "warmup_programs": 0}
+        self._pool = None  # prep worker pool (opts.prep_workers > 0)
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "ServeEngine":
         assert self._thread is None, "engine already started"
+        if self.opts.prep_workers > 0 and self._pool is None:
+            from mx_rcnn_tpu.data.workers import WorkerPool
+
+            # image-only pool (no roidb): submit() ships raw frames in,
+            # prepared bucket arrays come back through the shm ring
+            self._pool = WorkerPool(self.cfg,
+                                    num_workers=self.opts.prep_workers)
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="serve-dispatch", daemon=True)
         self._thread.start()
         return self
 
     def stop(self, timeout: float = 10.0):
+        if self._pool is not None:
+            self._pool.close(timeout=timeout)
+            self._pool = None
         with self._cond:
             self._stop = True
             pending = [r for q in self._queues.values() for r in q]
@@ -207,11 +226,17 @@ class ServeEngine:
             raise ValueError(f"expected (H, W, 3) RGB image, "
                              f"got shape {tuple(image.shape)}")
         tel = telemetry.get()
-        # host prep on the caller's thread: concurrent frontends
-        # parallelize the resize, and the dispatcher thread stays on the
-        # device hot path
-        prepared, im_info = prepare_image(np.asarray(image), self.cfg,
-                                          self._scale)
+        # host prep off the dispatcher thread either way: on the caller's
+        # thread (workers=0 — concurrent frontends parallelize the resize)
+        # or in the shared prep worker pool (byte-identical transform,
+        # pinned by test_loader_workers), so the device hot path never
+        # waits on a resize
+        if self._pool is not None:
+            prepared, im_info = self._pool.prepare(np.asarray(image),
+                                                   self._scale)
+        else:
+            prepared, im_info = prepare_image(np.asarray(image), self.cfg,
+                                              self._scale)
         # route on the LOGICAL bucket (pre-s2d padded shape) — under
         # HOST_S2D the prepared array is (H/2, W/2, 12), but orientation
         # and program identity are the bucket's, and /metrics should name

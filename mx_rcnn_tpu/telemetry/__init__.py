@@ -33,7 +33,7 @@ from mx_rcnn_tpu.telemetry.sink import (NULL, SCHEMA_VERSION, SUMMARY_NAME,
                                         NullTelemetry, Telemetry)
 
 __all__ = ["Telemetry", "NullTelemetry", "NULL", "SCHEMA_VERSION",
-           "SUMMARY_NAME", "configure", "get", "shutdown"]
+           "SUMMARY_NAME", "configure", "get", "reset_null", "shutdown"]
 
 _active: "NullTelemetry | Telemetry" = NULL
 
@@ -53,6 +53,16 @@ def configure(out_dir: str, rank: int = 0, world: int = 1,
 def get() -> "NullTelemetry | Telemetry":
     """The active sink (the no-op :data:`NULL` when none is configured)."""
     return _active
+
+
+def reset_null():
+    """Drop the active sink WITHOUT closing it — for forked children
+    (loader workers) that inherit the parent's open event stream.  The
+    child must stop emitting (its writes would interleave with the
+    parent's JSONL through the shared fd) but must not flush/close a file
+    the parent still owns."""
+    global _active
+    _active = NULL
 
 
 def shutdown():

@@ -24,6 +24,7 @@ from mx_rcnn_tpu.telemetry.sink import SCHEMA_VERSION
 # script/fault_smoke.sh) without knowing which counters might exist
 RECOVERY_COUNTERS = (
     "loader/bad_record",
+    "loader/worker_respawn",
     "train/nan_detected",
     "train/nan_skipped",
     "train/nan_rollback",

@@ -48,6 +48,13 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
                              "paths into the tree with python-literal values "
                              "(e.g. --cfg tpu__SCALES='((64,96),)' "
                              "--cfg TRAIN__BATCH_ROIS=32)")
+    parser.add_argument("--loader-workers", type=int, default=None,
+                        dest="loader_workers", metavar="N",
+                        help="host input-pipeline worker processes "
+                             "(data/workers.py): N > 0 fans decode/resize/"
+                             "flip over N processes with shared-memory "
+                             "handover, batches bit-identical to the "
+                             "default serial producer (0)")
     parser.add_argument("--telemetry-dir", default="", dest="telemetry_dir",
                         help="stream structured run telemetry here (JSONL "
                              "events + summary JSON; per-rank files on "
@@ -128,6 +135,8 @@ def parse_cfg_overrides(items) -> dict:
 
 def config_from_args(args, train: bool = True) -> Config:
     overrides = parse_cfg_overrides(getattr(args, "cfg", []))
+    if getattr(args, "loader_workers", None) is not None:
+        overrides["tpu__LOADER_WORKERS"] = int(args.loader_workers)
     if train:
         if args.lr is not None:
             overrides["TRAIN__LR"] = args.lr

@@ -509,7 +509,12 @@ def fit(cfg: Config, model, params, train_loader,
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
                 elif profiling and consumed >= 8:
-                    jax.block_until_ready(pending)
+                    # fence on state, not pending: a same-step cadence
+                    # fetch can have consumed pending (cleared to None),
+                    # and block_until_ready(None) returns immediately —
+                    # truncating the trace tail.  state is always the
+                    # latest dispatched step's output
+                    jax.block_until_ready(state)
                     jax.profiler.stop_trace()
                     profiling = False
                     profiled = True
@@ -621,7 +626,7 @@ def fit(cfg: Config, model, params, train_loader,
                     n=len(buf))
             buf = []
         if profiling:  # epoch shorter than the stop step: close the trace
-            jax.block_until_ready(pending)
+            jax.block_until_ready(state)  # pending may be fetched-and-None
             jax.profiler.stop_trace()
             profiling = False
             logger.info("wrote device trace to %s", profile_dir)

@@ -1,6 +1,10 @@
 #!/usr/bin/env bash
 # Telemetry smoke: 2-step synthetic train with --telemetry-dir on, then fold
 # the JSONL stream into the human table and BENCH-compatible rows.
+# Second pass: the same run with --loader-workers 2 — the multi-worker host
+# pipeline must emit its pool instrumentation (loader/assembly_wait,
+# loader/worker_busy, per-worker produce spans) and must not blow up
+# train/loader_wait vs the serial producer on the same fixture.
 set -e
 dir=${TELEMETRY_DIR:-/tmp/mxr_telemetry_smoke}
 rm -rf "$dir"
@@ -11,3 +15,37 @@ test -f "$dir/events_rank0.jsonl"
 test -f "$dir/summary.json"
 python scripts/telemetry_report.py "$dir"
 python scripts/telemetry_report.py "$dir" --bench
+
+wdir=${TELEMETRY_DIR:-/tmp/mxr_telemetry_smoke}_workers
+rm -rf "$wdir"
+python train_end2end.py --network resnet50 --synthetic --synthetic_images 8 \
+  --prefix /tmp/mxr_tel_smoke_ckpt_w --end_epoch 1 --num-steps 2 --frequent 1 \
+  --loader-workers 2 --telemetry-dir "$wdir" "$@"
+test -f "$wdir/events_rank0.jsonl"
+python scripts/telemetry_report.py "$wdir"
+python - "$dir" "$wdir" <<'EOF'
+import json, sys
+
+serial_dir, worker_dir = sys.argv[1], sys.argv[2]
+with open(f"{serial_dir}/summary.json") as f:
+    serial = json.load(f)
+with open(f"{worker_dir}/summary.json") as f:
+    workers = json.load(f)
+
+# the pool's own instrumentation must be in the stream
+for span in ("loader/assembly_wait", "loader/worker0/produce",
+             "loader/worker1/produce"):
+    assert span in workers["spans"], f"missing pool span {span}"
+assert "loader/worker_busy" in workers["gauges"], "missing worker_busy gauge"
+
+# loader_wait must not regress catastrophically vs serial: a 2-step smoke
+# on a loaded CI box is noisy, so this is a blown-up-pipeline tripwire
+# (order-of-magnitude), not a perf assertion — bench.py --mode loader is
+# the measured comparison
+s = serial["spans"].get("train/loader_wait", {}).get("total_s", 0.0)
+w = workers["spans"].get("train/loader_wait", {}).get("total_s", 0.0)
+assert w <= 10 * max(s, 0.1) + 2.0, \
+    f"loader_wait blew up with workers: {w:.3f}s vs serial {s:.3f}s"
+print(f"telemetry_smoke: pool counters present; "
+      f"loader_wait workers={w:.3f}s serial={s:.3f}s")
+EOF

@@ -82,7 +82,10 @@ def main(args):
     predictor = Predictor(model, params, cfg)
     engine = ServeEngine(predictor, cfg, ServeOptions(
         batch_size=args.serve_batch, max_delay_ms=args.max_delay_ms,
-        max_queue=args.max_queue, deadline_ms=args.deadline_ms)).start()
+        max_queue=args.max_queue, deadline_ms=args.deadline_ms,
+        # the common --loader-workers flag doubles as the serving prep
+        # pool size (same data/workers.py pool, image-only tasks)
+        prep_workers=args.loader_workers or 0)).start()
     warmup(engine)
 
     server = make_server(engine, port=args.port or None, host=args.host,

@@ -1,0 +1,213 @@
+"""Multi-worker host input pipeline (data/workers.py + loader wiring):
+workers=N must be batch-for-batch identical to the serial producer at the
+same seed (including mid-epoch auto-resume), isolate worker crashes the
+way PR-2 isolates bad records, reuse its shared-memory ring across
+epochs, and preserve order under worker skew."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import SyntheticDataset
+from mx_rcnn_tpu.data.loader import AnchorLoader, ROIIter, prepare_image
+from mx_rcnn_tpu.data import workers as workers_mod
+
+
+def tiny_cfg(n_workers=0):
+    cfg = generate_config(
+        "resnet50", "PascalVOC",
+        TRAIN__RPN_PRE_NMS_TOP_N=200, TRAIN__RPN_POST_NMS_TOP_N=32,
+        TRAIN__BATCH_ROIS=16,
+        tpu__SCALES=((64, 96),), tpu__MAX_GT=4,
+        tpu__LOADER_WORKERS=n_workers,
+    )
+    return cfg.replace(network=dataclasses.replace(
+        cfg.network, ANCHOR_SCALES=(2, 4), PIXEL_STDS=(127.0, 127.0, 127.0)))
+
+
+def tiny_roidb(n_images=10, proposals=False):
+    ds = SyntheticDataset(num_images=n_images, num_classes=5,
+                          height=64, width=96)
+    roidb = ds.gt_roidb()
+    if proposals:
+        rng = np.random.RandomState(7)
+        for rec in roidb:
+            k = rng.randint(1, 5)
+            x1 = rng.randint(0, 40, size=(k, 1)).astype(np.float32)
+            y1 = rng.randint(0, 30, size=(k, 1)).astype(np.float32)
+            rec["proposals"] = np.concatenate(
+                [x1, y1, x1 + 20, y1 + 20], axis=1)
+    return roidb
+
+
+def snapshot(loader, epochs=1):
+    out = []
+    for _ in range(epochs):
+        out.extend({k: v.copy() for k, v in b.items()} for b in loader)
+    return out
+
+
+def assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert sorted(x) == sorted(y), i
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k],
+                                          err_msg=f"batch {i} key {k}")
+
+
+def test_workers_match_serial_batches():
+    """The acceptance pin: workers=2 output is batch-for-batch identical
+    to workers=0 at the same seed, across epochs (epoch k's plan depends
+    on epoch k-1's RNG draws)."""
+    roidb = tiny_roidb()
+    serial = snapshot(AnchorLoader(roidb, tiny_cfg(0), batch_size=2,
+                                   shuffle=True, seed=3), epochs=2)
+    ld = AnchorLoader(roidb, tiny_cfg(2), batch_size=2, shuffle=True, seed=3)
+    try:
+        parallel = snapshot(ld, epochs=2)
+    finally:
+        ld.close_workers()
+    assert_batches_equal(serial, parallel)
+
+
+def test_roiiter_workers_match_serial():
+    """Same pin for the proposal loader: pixels come from the pool, rois
+    attach in the parent from the ACTUAL (possibly substituted) index."""
+    roidb = tiny_roidb(proposals=True)
+    serial = snapshot(ROIIter(roidb, tiny_cfg(0), batch_size=2,
+                              shuffle=True, seed=5))
+    it = ROIIter(roidb, tiny_cfg(2), batch_size=2, shuffle=True, seed=5)
+    try:
+        parallel = snapshot(it)
+    finally:
+        it.close_workers()
+    assert any("rois" in b for b in serial)
+    assert_batches_equal(serial, parallel)
+
+
+def test_mid_epoch_resume_with_workers():
+    """auto-resume's exact mid-epoch fast-forward (advance_epochs +
+    skip_next) with workers on: the resumed tail equals the uninterrupted
+    serial epoch's tail, batch for batch."""
+    roidb = tiny_roidb()
+    serial = snapshot(AnchorLoader(roidb, tiny_cfg(0), batch_size=2,
+                                   shuffle=True, seed=11), epochs=2)
+    steps = len(serial) // 2
+    ld = AnchorLoader(roidb, tiny_cfg(2), batch_size=2, shuffle=True,
+                      seed=11)
+    try:
+        ld.advance_epochs(1)  # resume inside epoch 1 (0-based)
+        ld.skip_next(2)
+        resumed = snapshot(ld)
+    finally:
+        ld.close_workers()
+    assert_batches_equal(serial[steps + 2:], resumed)
+
+
+def test_worker_crash_respawn(monkeypatch, tmp_path):
+    """A worker hard-crashing (os._exit) mid-task is respawned, its
+    in-flight tasks reissued, and the epoch still comes out identical to
+    the serial run — PR-2's isolation contract at process granularity."""
+    roidb = tiny_roidb()
+    serial = snapshot(AnchorLoader(roidb, tiny_cfg(0), batch_size=2,
+                                   shuffle=True, seed=2))
+    monkeypatch.setenv("MXR_FAULT_WORKER_CRASH_IDX", "3")
+    monkeypatch.setenv("MXR_FAULT_WORKER_CRASH_ONCE",
+                       str(tmp_path / "crashed.marker"))
+    ld = AnchorLoader(roidb, tiny_cfg(2), batch_size=2, shuffle=True, seed=2)
+    try:
+        parallel = snapshot(ld)
+        assert ld._pool is not None and ld._pool.respawns >= 1
+    finally:
+        ld.close_workers()
+    assert_batches_equal(serial, parallel)
+
+
+def test_worker_crash_systemic_limit(monkeypatch):
+    """A worker that dies on EVERY attempt must not respawn forever:
+    crossing the pool's respawn budget surfaces a RuntimeError through
+    the prefetcher instead of silently grinding."""
+    monkeypatch.setenv("MXR_FAULT_WORKER_CRASH_IDX", "3")  # no ONCE marker
+    monkeypatch.setattr(workers_mod, "MAX_WORKER_RESPAWNS", 2)
+    ld = AnchorLoader(tiny_roidb(), tiny_cfg(2), batch_size=2,
+                      shuffle=True, seed=2)
+    try:
+        with pytest.raises(RuntimeError, match="respawn"):
+            snapshot(ld)
+    finally:
+        ld.close_workers()
+
+
+def test_shm_slot_reuse_across_epochs():
+    """The pool (and its shm segment) persists across epochs; every ring
+    slot returns to the free list after each epoch — no slot leak, no
+    per-epoch reallocation."""
+    ld = AnchorLoader(tiny_roidb(), tiny_cfg(2), batch_size=2,
+                      shuffle=True, seed=4)
+    try:
+        snapshot(ld)
+        pool = ld._pool
+        assert pool is not None
+        name = pool._shm.name
+        snapshot(ld)
+        assert ld._pool is pool  # reused, not rebuilt
+        assert pool._shm.name == name
+        assert pool._free.qsize() == pool.n_slots  # all slots back
+        assert not pool._pending
+    finally:
+        ld.close_workers()
+
+
+def test_order_preserved_under_slow_worker(monkeypatch):
+    """Deliberate worker skew (one worker sleeps per task) must not
+    reorder samples: the collector hands results back in task order."""
+    roidb = tiny_roidb(n_images=8)
+    serial = snapshot(AnchorLoader(roidb, tiny_cfg(0), batch_size=2,
+                                   shuffle=True, seed=6))
+    monkeypatch.setenv("MXR_FAULT_WORKER_SLOW", "0:0.05")
+    ld = AnchorLoader(roidb, tiny_cfg(2), batch_size=2, shuffle=True, seed=6)
+    try:
+        parallel = snapshot(ld)
+    finally:
+        ld.close_workers()
+    assert_batches_equal(serial, parallel)
+
+
+def test_serve_prepare_parity():
+    """The serving ingest path through the pool is byte-identical to the
+    caller-thread prepare_image it replaces."""
+    cfg = tiny_cfg()
+    pool = workers_mod.WorkerPool(cfg, num_workers=1)
+    try:
+        rng = np.random.RandomState(0)
+        for shape in [(50, 70, 3), (70, 50, 3)]:  # both orientations
+            img = rng.randint(0, 255, shape, np.uint8)
+            got, got_info = pool.prepare(img, cfg.tpu.SCALES[0])
+            want, want_info = prepare_image(img, cfg, cfg.tpu.SCALES[0])
+            np.testing.assert_array_equal(got, want)
+            np.testing.assert_array_equal(got_info, want_info)
+    finally:
+        pool.close()
+
+
+def test_bad_record_isolated_inside_worker(monkeypatch):
+    """A record that fails to LOAD (not crash) inside a worker follows
+    the PR-2 substitution contract: next record substituted, epoch
+    completes, same shapes."""
+    roidb = tiny_roidb()
+    bad = dict(roidb[3])
+    bad["image_array"] = None  # load raises TypeError in the worker
+    roidb_bad = list(roidb)
+    roidb_bad[3] = bad
+    ld = AnchorLoader(roidb_bad, tiny_cfg(2), batch_size=2, shuffle=False,
+                      seed=0)
+    try:
+        batches = snapshot(ld)
+    finally:
+        ld.close_workers()
+    assert len(batches) == len(roidb) // 2
+    for b in batches:
+        assert b["images"].shape[0] == 2
