@@ -576,6 +576,10 @@ def main():
                          "infer-mask modes emit the same per-phase spans "
                          "as real training/eval (the instrumented loader "
                          "and tester run inside the measured loop)")
+    ap.add_argument("--obs-port", type=int, default=0, dest="obs_port",
+                    help="live Prometheus /metrics + /healthz on "
+                         "127.0.0.1:PORT while the bench runs "
+                         "(telemetry/obs.py; 0 = off)")
     args = ap.parse_args()
     from mx_rcnn_tpu.tools.common import parse_cfg_overrides
 
@@ -585,12 +589,13 @@ def main():
         args.network = ("resnet101_fpn_mask" if args.mode == "infer-mask"
                         else "resnet101")
     from mx_rcnn_tpu import telemetry
+    from mx_rcnn_tpu.tools.common import start_observability
 
-    if args.telemetry_dir:
-        telemetry.configure(args.telemetry_dir,
-                            run_meta={"driver": "bench", "mode": args.mode,
-                                      "batch": args.batch,
-                                      "network": args.network})
+    obs = start_observability(args, "bench",
+                              run_meta={"mode": args.mode,
+                                        "batch": args.batch,
+                                        "network": args.network},
+                              configure_telemetry=True)
 
     tel = telemetry.get()
     t_bench = time.perf_counter()
@@ -689,10 +694,9 @@ def main():
         out["baseline_recorded"] = True
     if infer_method is not None:
         out["method"] = infer_method
-    if args.telemetry_dir:
+    if tel.enabled:
         tel.gauge(f"bench/{metric}", value)
-        tel.write_summary(extra={"bench": out})
-        telemetry.shutdown()
+    obs.close(extra={"bench": out})
     print(json.dumps(out))
 
 

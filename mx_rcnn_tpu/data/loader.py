@@ -120,6 +120,9 @@ def _load_record_isolated(roidb: list, i: int, cfg: Config,
             state[0] += 1
             telemetry.get().counter("loader/bad_record")
             if state[0] >= MAX_CONSECUTIVE_BAD_RECORDS:
+                telemetry.get().dump_flight(
+                    "loader_systemic", consecutive_bad=state[0],
+                    last_index=j, error=f"{type(e).__name__}: {e}"[:500])
                 raise RuntimeError(
                     f"{state[0]} consecutive roidb records failed to load "
                     f"(last: index {j}, {type(e).__name__}: {e}) — this "
@@ -276,6 +279,9 @@ class _Prefetcher:
                 age = time.monotonic() - self._beat
                 if age < self._watchdog_s and self._t.is_alive():
                     continue  # slow but advancing (or just started)
+                telemetry.get().dump_flight(
+                    "prefetch_watchdog", age_s=round(age, 1),
+                    producer_alive=self._t.is_alive())
                 raise RuntimeError(
                     f"prefetch queue empty with no producer heartbeat for "
                     f"{age:.0f}s (watchdog {self._watchdog_s:.0f}s) — "

@@ -447,6 +447,10 @@ class WorkerPool:
                     f"looks systemic (OOM-killed decode? poisoned "
                     f"record crashing native code?), not a stray fault")
                 self._broken = err
+                telemetry.get().dump_flight(
+                    "loader_systemic", worker=w, exitcode=p.exitcode,
+                    respawns=self.respawns,
+                    max_respawns=self.max_respawns)
                 for s in lost:
                     self._pending[s].done = True
                     self._pending[s].error = str(err)

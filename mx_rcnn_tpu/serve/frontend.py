@@ -19,7 +19,10 @@ Endpoints:
   "queue_wait_ms"}``; 503 queue full (backpressure — retry with backoff);
   504 deadline exceeded; 400 malformed.
 * ``GET /healthz`` — 200 once the engine thread is up.
-* ``GET /metrics`` — engine counters + queue state as JSON.
+* ``GET /metrics`` — engine counters + queue state as JSON; with
+  ``Accept: text/plain`` or ``?format=prom``, Prometheus text exposition
+  instead — rendered by ``telemetry/obs.py`` from the same registry the
+  ``--obs-port`` server scrapes (one metrics path, not two).
 
 Everything here is stdlib (``http.server`` + ``ThreadingHTTPServer``):
 request threads do the image prep in ``engine.submit`` concurrently, which
@@ -42,6 +45,7 @@ import numpy as np
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.serve.engine import (DeadlineExceededError, RejectedError,
                                       ServeEngine)
+from mx_rcnn_tpu.telemetry.obs import PROM_CONTENT_TYPE, serve_prometheus
 
 # result-wait ceiling for one HTTP request; the engine's own per-request
 # deadline (default ServeOptions.deadline_ms) fires long before this —
@@ -119,9 +123,12 @@ class _Handler(BaseHTTPRequestHandler):
         return super().address_string()
 
     def _reply(self, status: int, doc: dict):
-        body = json.dumps(doc).encode()
+        self._reply_raw(status, json.dumps(doc).encode(),
+                        "application/json")
+
+    def _reply_raw(self, status: int, body: bytes, ctype: str):
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -129,11 +136,20 @@ class _Handler(BaseHTTPRequestHandler):
     # -- endpoints -------------------------------------------------------
 
     def do_GET(self):
-        if self.path == "/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
             self._reply(200, {"status": "ok",
                               "queue_depth": self.engine.queue_depth()})
-        elif self.path == "/metrics":
-            self._reply(200, self.engine.metrics())
+        elif path == "/metrics":
+            # content negotiation: JSON stays the default for existing
+            # callers; Prometheus scrapers ask via Accept or ?format=prom
+            accept = self.headers.get("Accept", "")
+            if "format=prom" in query or "text/plain" in accept:
+                self._reply_raw(200,
+                                serve_prometheus(self.engine).encode(),
+                                PROM_CONTENT_TYPE)
+            else:
+                self._reply(200, self.engine.metrics())
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -189,9 +205,12 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
 
 def unix_http_request(sock_path: str, method: str, path: str,
                       doc: Optional[dict] = None,
-                      timeout: float = 60.0) -> tuple:
+                      timeout: float = 60.0,
+                      headers: Optional[dict] = None) -> tuple:
     """Minimal HTTP client over a Unix socket → (status, response_doc).
-    The test/loadgen counterpart of ``make_server(unix_socket=...)``."""
+    The test/loadgen counterpart of ``make_server(unix_socket=...)``.
+    JSON responses come back parsed; anything else (the Prometheus text
+    negotiated via ``headers={"Accept": "text/plain"}``) as str."""
     import http.client
 
     class Conn(http.client.HTTPConnection):
@@ -206,11 +225,15 @@ def unix_http_request(sock_path: str, method: str, path: str,
     conn = Conn()
     try:
         body = json.dumps(doc).encode() if doc is not None else None
-        conn.request(method, path, body=body,
-                     headers={"Content-Type": "application/json"}
-                     if body else {})
+        hdrs = dict(headers or {})
+        if body:
+            hdrs.setdefault("Content-Type", "application/json")
+        conn.request(method, path, body=body, headers=hdrs)
         resp = conn.getresponse()
-        return resp.status, json.loads(resp.read())
+        raw = resp.read()
+        if "json" in (resp.getheader("Content-Type") or ""):
+            return resp.status, json.loads(raw)
+        return resp.status, raw.decode()
     finally:
         conn.close()
 

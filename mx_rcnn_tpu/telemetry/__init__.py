@@ -29,24 +29,32 @@ from __future__ import annotations
 
 from typing import Optional
 
-from mx_rcnn_tpu.telemetry.sink import (NULL, SCHEMA_VERSION, SUMMARY_NAME,
-                                        NullTelemetry, Telemetry)
+from mx_rcnn_tpu.telemetry.sink import (NULL, RING_SIZE, SCHEMA_VERSION,
+                                        SUMMARY_NAME, NullTelemetry,
+                                        Telemetry)
 
-__all__ = ["Telemetry", "NullTelemetry", "NULL", "SCHEMA_VERSION",
-           "SUMMARY_NAME", "configure", "get", "reset_null", "shutdown"]
+__all__ = ["Telemetry", "NullTelemetry", "NULL", "RING_SIZE",
+           "SCHEMA_VERSION", "SUMMARY_NAME", "configure", "get",
+           "reset_null", "shutdown"]
 
 _active: "NullTelemetry | Telemetry" = NULL
 
 
 def configure(out_dir: str, rank: int = 0, world: int = 1,
-              run_meta: Optional[dict] = None) -> Telemetry:
+              run_meta: Optional[dict] = None, stream: bool = True,
+              trace: Optional[bool] = None) -> Telemetry:
     """Open a run's sink and make it the active one.  Reconfiguring over a
     live sink closes it first (one active run per process — matching the
-    one-event-file-per-rank layout)."""
+    one-event-file-per-rank layout).  ``stream=False`` keeps the sink
+    purely in-memory (aggregates + flight ring, no event file) — the obs
+    server uses it when ``--obs-port`` is set without ``--telemetry-dir``.
+    ``trace`` opts span records into wall-start timestamps (default: the
+    ``MXR_TELEMETRY_TRACE`` env var)."""
     global _active
     if _active.enabled:
         _active.close()
-    _active = Telemetry(out_dir, rank=rank, world=world, run_meta=run_meta)
+    _active = Telemetry(out_dir, rank=rank, world=world, run_meta=run_meta,
+                        stream=stream, trace=trace)
     return _active
 
 

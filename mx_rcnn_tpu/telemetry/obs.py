@@ -1,0 +1,382 @@
+"""Live observability plane: Prometheus endpoint, cross-rank snapshot
+fold, and crash-time flight-recorder wiring.
+
+PR 1's telemetry is post-hoc — events become readable after the run
+closes its JSONL stream.  This module makes a live run observable:
+
+* :func:`prometheus_text` — render per-rank :meth:`Telemetry.summary`
+  dicts into the Prometheus text exposition format.  One renderer serves
+  both the obs server below and serve's ``/metrics`` content negotiation
+  (``frontend.py``), so there is exactly one metrics registry: the
+  telemetry sink's aggregates.
+* :class:`ObsServer` — a stdlib ``ThreadingHTTPServer`` on a daemon
+  thread serving ``GET /metrics`` and ``GET /healthz``.  Bound only when
+  a driver passes ``--obs-port`` (default off: zero network binds).
+* Cross-rank fold: every rank runs a :class:`SnapshotWriter` dropping
+  ``snapshot_rank{N}.json`` under the telemetry dir every couple of
+  seconds (atomic tmp+rename, same contract as ``write_summary``); the
+  rank-0 server folds peer snapshots into its own live summary, labeled
+  ``rank="N"``, so one scrape sees the whole job.  No new transport —
+  the shared filesystem the per-rank event files already require.
+* :class:`ObsPlane` — the driver-facing lifecycle bundle: configures a
+  sink when needed (in-memory when ``--obs-port`` is set without
+  ``--telemetry-dir``), starts the writer + (rank 0) server, installs a
+  ``sys.excepthook`` that flight-dumps on unhandled exceptions, and
+  tears everything down (writing the rank-0 summary when it owns the
+  sink) on ``close``.
+
+Stdlib only — no jax import; safe in the loader's producer threads and
+on hosts with no accelerator.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.logger import logger
+
+SNAPSHOT_INTERVAL_S = 2.0
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# ServeEngine.counters key → telemetry counter name (the engine mirrors
+# these into the sink when one is active; when none is, the frontend's
+# Prometheus path rebuilds them from the engine so both configurations
+# expose the same families)
+ENGINE_COUNTER_NAMES = {
+    "requests": "serve/requests",
+    "served": "serve/images",
+    "batches": "serve/batches",
+    "rejected": "serve/rejected",
+    "deadline_exceeded": "serve/deadline_exceeded",
+    "recompiles": "serve/recompile",
+    "warmup_programs": "serve/warmup_programs",
+}
+
+
+def _prom_name(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def prometheus_text(per_rank: dict, ages: Optional[dict] = None) -> str:
+    """Render ``{rank: summary_dict}`` (the :meth:`Telemetry.summary`
+    shape) as Prometheus text exposition.  Families:
+
+    * counter ``name`` → ``mxr_<name>_total{rank="N"}``
+    * span ``name`` → ``mxr_<name>_seconds_total`` +
+      ``mxr_<name>_calls_total`` (counters) and
+      ``mxr_<name>_seconds_max`` (gauge)
+    * gauge ``name`` → ``mxr_<name>{rank="N",stat="last|min|max|mean"}``
+      — the queue-depth extremes, not just the final sample
+    * ``mxr_up{rank="N"} 1`` for every rank present, plus
+      ``mxr_snapshot_age_seconds`` for ranks folded from snapshot files
+      (``ages``: rank → seconds since the snapshot was written).
+    """
+    counters: dict = {}  # family -> [(rank, value)]
+    gauges: dict = {}    # family -> [(rank, labels, value)]
+    for rank in sorted(per_rank):
+        s = per_rank[rank] or {}
+        gauges.setdefault("mxr_up", []).append((rank, "", 1))
+        for name, total in (s.get("counters") or {}).items():
+            fam = f"mxr_{_prom_name(name)}_total"
+            counters.setdefault(fam, []).append((rank, total))
+        for name, sp in (s.get("spans") or {}).items():
+            base = f"mxr_{_prom_name(name)}"
+            counters.setdefault(f"{base}_seconds_total", []).append(
+                (rank, sp.get("total_s", 0.0)))
+            counters.setdefault(f"{base}_calls_total", []).append(
+                (rank, sp.get("count", 0)))
+            gauges.setdefault(f"{base}_seconds_max", []).append(
+                (rank, "", sp.get("max_s", 0.0)))
+        for name, g in (s.get("gauges") or {}).items():
+            fam = f"mxr_{_prom_name(name)}"
+            for stat in ("last", "min", "max", "mean"):
+                gauges.setdefault(fam, []).append(
+                    (rank, f',stat="{stat}"', g.get(stat, 0.0)))
+    for rank, age in sorted((ages or {}).items()):
+        gauges.setdefault("mxr_snapshot_age_seconds", []).append(
+            (rank, "", age))
+
+    def fmt(v):
+        return repr(round(float(v), 9)) if isinstance(v, float) else str(v)
+
+    lines = []
+    for fam in sorted(counters):
+        lines.append(f"# TYPE {fam} counter")
+        for rank, v in counters[fam]:
+            lines.append(f'{fam}{{rank="{rank}"}} {fmt(v)}')
+    for fam in sorted(gauges):
+        lines.append(f"# TYPE {fam} gauge")
+        for rank, labels, v in gauges[fam]:
+            lines.append(f'{fam}{{rank="{rank}"{labels}}} {fmt(v)}')
+    return "\n".join(lines) + "\n"
+
+
+# -- cross-rank snapshots ------------------------------------------------
+
+
+def snapshot_path(out_dir: str, rank: int) -> str:
+    return os.path.join(out_dir, f"snapshot_rank{rank}.json")
+
+
+def write_snapshot(tel=None) -> Optional[str]:
+    """Atomically publish the active sink's summary for the rank-0 obs
+    server to fold (peers have no HTTP server — files are the bus)."""
+    tel = tel if tel is not None else telemetry.get()
+    if not tel.enabled or not tel.out_dir:
+        return None
+    path = snapshot_path(tel.out_dir, tel.rank)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"t": time.time(), "summary": tel.summary()}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_peer_snapshots(out_dir: str, skip_rank: Optional[int] = None):
+    """``(per_rank_summaries, ages)`` from ``snapshot_rank*.json`` files.
+    A half-written or vanished file is skipped — the writer is atomic, so
+    this only covers peers dying mid-publish."""
+    per_rank: dict = {}
+    ages: dict = {}
+    for path in sorted(glob.glob(os.path.join(out_dir,
+                                              "snapshot_rank*.json"))):
+        m = re.search(r"snapshot_rank(\d+)\.json$", path)
+        if not m:
+            continue
+        rank = int(m.group(1))
+        if rank == skip_rank:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            per_rank[rank] = doc.get("summary") or {}
+            ages[rank] = max(time.time() - float(doc.get("t", 0.0)), 0.0)
+        except (OSError, ValueError):
+            continue
+    return per_rank, ages
+
+
+class SnapshotWriter(threading.Thread):
+    """Daemon publishing the active sink's summary every ``interval_s``.
+    ``stop()`` writes one final snapshot so even a run shorter than the
+    interval leaves its rank visible to the fold."""
+
+    def __init__(self, interval_s: float = SNAPSHOT_INTERVAL_S):
+        super().__init__(name="telemetry-snapshot", daemon=True)
+        self._interval = interval_s
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(self._interval):
+            try:
+                write_snapshot()
+            except OSError as e:  # full/unmounted disk must not kill a run
+                logger.warning("telemetry snapshot write failed: %s", e)
+
+    def stop(self):
+        self._stop.set()
+        try:
+            write_snapshot()
+        except OSError:
+            pass
+
+
+# -- the HTTP endpoint ---------------------------------------------------
+
+
+class _ObsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    obs: "ObsServer" = None  # set by ObsServer subclassing
+
+    def log_message(self, fmt, *args):
+        logger.debug("obs http: " + fmt, *args)
+
+    def _reply(self, status: int, body: str, ctype: str):
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            tel = telemetry.get()
+            self._reply(200, json.dumps(
+                {"status": "ok", "rank": tel.rank,
+                 "telemetry": bool(tel.enabled)}), "application/json")
+        elif path == "/metrics":
+            self._reply(200, self.obs.render_metrics(), PROM_CONTENT_TYPE)
+        else:
+            self._reply(404, json.dumps({"error": f"no route {path}"}),
+                        "application/json")
+
+
+class ObsServer:
+    """The rank-0 metrics endpoint: own live summary + peer snapshots.
+    ``port=0`` binds an ephemeral port (tests); read it back from
+    ``self.port``."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 telemetry_dir: str = ""):
+        self.telemetry_dir = telemetry_dir
+
+        class Handler(_ObsHandler):
+            pass
+
+        Handler.obs = self
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-http", daemon=True)
+        self._thread.start()
+
+    def render_metrics(self) -> str:
+        tel = telemetry.get()
+        own_rank = tel.rank if tel.enabled else None
+        per_rank: dict = {}
+        ages: dict = {}
+        if self.telemetry_dir:
+            per_rank, ages = read_peer_snapshots(self.telemetry_dir,
+                                                 skip_rank=own_rank)
+        if tel.enabled:
+            per_rank[tel.rank] = tel.summary()
+        return prometheus_text(per_rank, ages)
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+# -- driver lifecycle ----------------------------------------------------
+
+
+class ObsPlane:
+    """Everything a driver needs for the live plane, in one handle.
+
+    * inert (no sink, no threads, no binds) unless ``--obs-port`` is set
+      or the driver asked it to own plain ``--telemetry-dir``
+      configuration (``configure_telemetry=True`` — test/serve/bench,
+      whose sinks aren't owned by ``fit``);
+    * with a port: configures a sink when none is active (in-memory when
+      there is no telemetry dir), starts the snapshot writer (dir set),
+      binds the HTTP server on rank 0 only, and installs an excepthook
+      that flight-dumps before the traceback prints;
+    * ``close(extra=...)`` reverses all of it, writing the rank-0
+      ``summary.json`` when the plane owns the sink and a dir is set
+      (the same contract ``fit`` honors when IT owns the sink).
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 telemetry_dir: str = "", rank: int = 0, world: int = 1,
+                 run_meta: Optional[dict] = None,
+                 configure_telemetry: bool = False,
+                 snapshot_interval_s: float = SNAPSHOT_INTERVAL_S):
+        self.active = bool(port)
+        self.rank = int(rank)
+        self.telemetry_dir = telemetry_dir
+        self.owns_sink = False
+        self.server: Optional[ObsServer] = None
+        self.writer: Optional[SnapshotWriter] = None
+        self._prev_hook = None
+        self._installed_hook = None
+        need_sink = self.active or (configure_telemetry and telemetry_dir)
+        if need_sink and not telemetry.get().enabled:
+            telemetry.configure(telemetry_dir, rank=rank, world=world,
+                                run_meta=run_meta,
+                                stream=bool(telemetry_dir))
+            self.owns_sink = True
+        if not self.active:
+            return
+        if telemetry_dir:
+            self.writer = SnapshotWriter(snapshot_interval_s)
+            self.writer.start()
+        elif world > 1 and rank == 0:
+            logger.warning("--obs-port without --telemetry-dir: no "
+                           "snapshot files, the scrape only sees rank 0")
+        if rank == 0:
+            self.server = ObsServer(port, host=host,
+                                    telemetry_dir=telemetry_dir)
+            logger.info("obs server on http://%s:%d (/metrics, /healthz)",
+                        self.server.host, self.server.port)
+        self._prev_hook = sys.excepthook
+        # bind once: each `self._excepthook` access makes a fresh bound
+        # method, and close() must compare by identity to restore safely
+        self._installed_hook = self._excepthook
+        sys.excepthook = self._installed_hook
+
+    def _excepthook(self, exc_type, exc, tb):
+        try:
+            telemetry.get().dump_flight(
+                "unhandled_exception", type=exc_type.__name__,
+                message=str(exc)[:500])
+        except Exception:  # noqa: BLE001 — never mask the real traceback
+            pass
+        (self._prev_hook or sys.__excepthook__)(exc_type, exc, tb)
+
+    def close(self, extra: Optional[dict] = None):
+        if self._prev_hook is not None:
+            if sys.excepthook is self._installed_hook:
+                sys.excepthook = self._prev_hook
+            self._prev_hook = None
+        if self.writer is not None:
+            self.writer.stop()
+            self.writer = None
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+        if self.owns_sink:
+            self.owns_sink = False
+            tel = telemetry.get()
+            if tel.enabled and self.rank == 0 and self.telemetry_dir:
+                path = tel.write_summary(extra=extra)
+                logger.info("wrote telemetry summary to %s", path)
+            telemetry.shutdown()
+
+
+# -- serve frontend bridge -----------------------------------------------
+
+
+def engine_summary(engine) -> dict:
+    """A summary-shaped dict for a :class:`ServeEngine`: the active
+    sink's aggregates (when one is on) with the engine's own counters and
+    live queue depth folded over them — the engine is authoritative for
+    ``serve/*`` (its counters exist even with telemetry off)."""
+    tel = telemetry.get()
+    base = tel.summary() if tel.enabled else {}
+    m = engine.metrics()
+    counters = dict(base.get("counters") or {})
+    for key, name in ENGINE_COUNTER_NAMES.items():
+        if key in m.get("counters", {}):
+            counters[name] = m["counters"][key]
+    gauges = dict(base.get("gauges") or {})
+    depth = m.get("queue_depth", 0)
+    live = gauges.get("serve/queue_depth", {})
+    gauges["serve/queue_depth"] = {
+        "count": live.get("count", 0) + 1,
+        "mean": live.get("mean", depth),
+        "min": min(live.get("min", depth), depth),
+        "max": max(live.get("max", depth), depth),
+        "last": depth,
+    }
+    return {"spans": base.get("spans") or {}, "counters": counters,
+            "gauges": gauges}
+
+
+def serve_prometheus(engine) -> str:
+    """The frontend's ``/metrics?format=prom`` body — same renderer and
+    registry as the obs server (one metrics path, not two)."""
+    rank = telemetry.get().rank
+    return prometheus_text({rank: engine_summary(engine)})

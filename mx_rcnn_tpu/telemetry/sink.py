@@ -37,10 +37,28 @@ JSONL event schema (``v`` = schema version, one object per line):
 
 ``summary()`` aggregates per name: spans → count/total_s/mean_s/min_s/
 max_s, counters → total, gauges → count/mean/min/max/last.
+
+Two additions for the live observability plane (``telemetry/obs.py``):
+
+* **Flight recorder** — every emitted event also lands in a bounded
+  in-memory ring (:data:`RING_SIZE` events).  ``dump_flight(reason)``
+  writes the ring atomically to ``flight_{rank}.jsonl`` so the last
+  seconds before a NaN halt / SIGTERM / loader systemic failure survive
+  even when the buffered event stream didn't flush.  The dump path uses
+  a timeout lock acquire: it may be called from a signal handler that
+  interrupted a thread holding the sink lock, and must degrade (skip
+  the stream write) rather than deadlock.
+* **Trace timestamps** — with ``trace=True`` (or env
+  ``MXR_TELEMETRY_TRACE=1``) span records carry ``"ts"``, the wall-clock
+  START of the span, so ``telemetry/trace.py`` can place them exactly on
+  a Chrome/Perfetto timeline.  Without it, trace export derives the
+  start as ``t - dur_s`` (``t`` is recorded at span END).  Off by
+  default: one extra ``time.time()`` per span is cheap but not free.
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
@@ -49,6 +67,9 @@ from typing import Optional
 
 SCHEMA_VERSION = 1
 SUMMARY_NAME = "summary.json"
+# flight-recorder ring bound: ~4k events ≈ the last few hundred steps of
+# a fully-instrumented train loop, < 1 MB of dicts
+RING_SIZE = 4096
 
 
 class _NullSpan:
@@ -72,11 +93,12 @@ class NullTelemetry:
 
     enabled = False
     rank = 0
+    trace = False
 
     def span(self, name):
         return _NULL_SPAN
 
-    def add(self, name, seconds, n=1):
+    def add(self, name, seconds, n=1, ts=None):
         pass
 
     def counter(self, name, inc=1):
@@ -87,6 +109,9 @@ class NullTelemetry:
 
     def meta(self, name, **fields):
         pass
+
+    def dump_flight(self, reason, **fields):
+        return None
 
     def summary(self) -> dict:
         return {}
@@ -102,20 +127,26 @@ NULL = NullTelemetry()
 
 
 class _Span:
-    """Context manager recording a perf_counter duration into its sink."""
+    """Context manager recording a perf_counter duration into its sink.
+    Durations always come from the monotonic clock; when the sink is in
+    trace mode the wall-clock START is captured too so the trace export
+    can place the span exactly (rather than deriving start = end - dur
+    from the emit-time ``t``)."""
 
-    __slots__ = ("_tel", "_name", "_t0")
+    __slots__ = ("_tel", "_name", "_t0", "_w0")
 
     def __init__(self, tel: "Telemetry", name: str):
         self._tel = tel
         self._name = name
 
     def __enter__(self):
+        self._w0 = time.time() if self._tel.trace else None
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self._tel.add(self._name, time.perf_counter() - self._t0)
+        self._tel.add(self._name, time.perf_counter() - self._t0,
+                      ts=self._w0)
         return False
 
 
@@ -130,14 +161,21 @@ class Telemetry:
     enabled = True
 
     def __init__(self, out_dir: str, rank: int = 0, world: int = 1,
-                 run_meta: Optional[dict] = None, stream: bool = True):
+                 run_meta: Optional[dict] = None, stream: bool = True,
+                 trace: Optional[bool] = None, ring_size: int = RING_SIZE):
         self.out_dir = out_dir
         self.rank = int(rank)
         self.world = int(world)
+        if trace is None:  # env opt-in so drivers need no new flag
+            env = os.environ.get("MXR_TELEMETRY_TRACE", "")
+            trace = env.strip().lower() in ("1", "true", "yes", "on")
+        self.trace = bool(trace)
         self._lock = threading.Lock()
         self._spans: dict = {}     # name -> [count, total, min, max]
         self._counters: dict = {}  # name -> int
         self._gauges: dict = {}    # name -> [count, total, min, max, last]
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(int(ring_size), 1))
         self._run_meta = dict(run_meta or {})
         self._file = None
         if stream:
@@ -154,14 +192,17 @@ class Telemetry:
         return _Span(self, name)
 
     def _emit(self, rec: dict):
+        self._ring.append(rec)  # flight recorder: bounded, crash-readable
         if self._file is not None:
             self._file.write(json.dumps(rec) + "\n")
 
-    def add(self, name: str, seconds: float, n: int = 1):
+    def add(self, name: str, seconds: float, n: int = 1,
+            ts: Optional[float] = None):
         """Record a measured duration (the non-context-manager span form —
         callers that already hold a perf_counter difference, e.g. the
         trainer's loader-wait accumulation, feed it here).  ``n`` lets one
-        record stand for n back-to-back occurrences (group dispatches)."""
+        record stand for n back-to-back occurrences (group dispatches).
+        ``ts`` is an optional wall-clock span START (trace mode)."""
         with self._lock:
             s = self._spans.get(name)
             if s is None:
@@ -175,6 +216,8 @@ class Telemetry:
                    "kind": "span", "name": name, "dur_s": seconds}
             if n != 1:
                 rec["n"] = n
+            if ts is not None:
+                rec["ts"] = ts
             self._emit(rec)
 
     def counter(self, name: str, inc: int = 1):
@@ -205,6 +248,50 @@ class Telemetry:
             self._emit({"v": SCHEMA_VERSION, "t": time.time(),
                         "rank": self.rank, "kind": "meta", "name": name,
                         "fields": fields})
+
+    def dump_flight(self, reason: str, **fields) -> Optional[str]:
+        """Flight-recorder dump: append a ``flight_trigger`` meta event
+        explaining WHY, then atomically write the event ring to
+        ``flight_{rank}.jsonl`` under ``out_dir``.
+
+        Callable from signal handlers and failure paths: the lock acquire
+        is bounded, and when it times out (the handler interrupted a
+        thread that holds the sink lock) the stream write is skipped but
+        the ring still gets the trigger and the dump proceeds — a flight
+        dump that deadlocks the dying process would be worse than a
+        slightly torn one.  Returns the dump path (None without a dir).
+        """
+        rec = {"v": SCHEMA_VERSION, "t": time.time(), "rank": self.rank,
+               "kind": "meta", "name": "flight_trigger",
+               "fields": {"reason": reason, **fields}}
+        got = self._lock.acquire(timeout=1.0)
+        try:
+            self._ring.append(rec)
+            if got and self._file is not None:
+                self._file.write(json.dumps(rec) + "\n")
+                self._file.flush()
+            events = None
+            for _ in range(3):  # lockless list(deque) may race an append
+                try:
+                    events = list(self._ring)
+                    break
+                except RuntimeError:
+                    continue
+            if events is None:
+                events = [rec]
+        finally:
+            if got:
+                self._lock.release()
+        if not self.out_dir:
+            return None
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(self.out_dir, f"flight_{self.rank}.jsonl")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+        os.replace(tmp, path)
+        return path
 
     # -- reading ---------------------------------------------------------
 

@@ -1,0 +1,129 @@
+"""Fold telemetry JSONL events into Chrome/Perfetto ``trace_event`` JSON.
+
+``scripts/telemetry_report.py --trace out.json`` turns a run's event
+stream into a browsable timeline: open the file in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``.  Layout:
+
+* one **process** per rank (``pid`` = rank — the multi-host event files
+  fold into side-by-side process groups);
+* one **track** (``tid``) per subsystem per rank — ``train``, ``loader``,
+  ``eval``, ``serve`` — plus one per loader worker
+  (``loader/worker{N}/...`` span names), so the host pipeline's per-worker
+  produce spans sit on their own rows under the rank;
+* spans → complete events (``ph: "X"``).  The start is the recorded
+  wall-clock span start (``ts``, present when the sink ran in trace
+  mode) or derived as ``t - dur_s`` (``t`` is stamped at span END).
+  Within one track, containment nests exactly as Perfetto expects
+  (``train/epoch`` wraps the epoch's ``train/dispatch`` spans);
+* counters/gauges → counter events (``ph: "C"``): counters plot their
+  cumulative total, gauges the sampled value;
+* meta events (``flight_trigger``, ``nan_detected``, ``recompile`` ...)
+  → instant events (``ph: "i"``) so the crash markers are visible on the
+  timeline.
+
+Timestamps are microseconds relative to the earliest event in the fold
+(absolute unix µs blows up the Perfetto axis).  Stdlib only — no jax.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Iterable, List, Optional
+
+_WORKER_RE = re.compile(r"^loader/(worker\d+)/")
+
+
+def _track(name: str) -> str:
+    m = _WORKER_RE.match(name)
+    if m:
+        return m.group(1)
+    return name.split("/", 1)[0] if "/" in name else "main"
+
+
+def _span_start(e: dict) -> Optional[float]:
+    ts = e.get("ts")
+    if ts is not None:
+        return float(ts)
+    t = e.get("t")
+    if t is None:
+        return None
+    return float(t) - float(e.get("dur_s", 0.0))
+
+
+def trace_events(events: Iterable[dict]) -> List[dict]:
+    """Telemetry event dicts → ``trace_event`` list (see module doc)."""
+    events = [e for e in events if isinstance(e, dict) and "kind" in e]
+    starts = []
+    for e in events:
+        if e["kind"] == "span":
+            s = _span_start(e)
+            if s is not None:
+                starts.append(s)
+        elif e.get("t") is not None:
+            starts.append(float(e["t"]))
+    t0 = min(starts) if starts else 0.0
+
+    out: List[dict] = []
+    tids: dict = {}       # (pid, track_name) -> tid
+    cum: dict = {}        # (pid, counter_name) -> running total
+
+    def tid_for(pid: int, track: str) -> int:
+        key = (pid, track)
+        if key not in tids:
+            tids[key] = len([1 for (p, _) in tids if p == pid]) + 1
+        return tids[key]
+
+    for e in sorted(events, key=lambda e: e.get("t", 0.0)):
+        pid = int(e.get("rank", 0))
+        kind = e["kind"]
+        name = e.get("name", "?")
+        if kind == "span":
+            start = _span_start(e)
+            if start is None:
+                continue
+            ev = {"name": name, "ph": "X", "pid": pid,
+                  "tid": tid_for(pid, _track(name)),
+                  "ts": round((start - t0) * 1e6, 3),
+                  "dur": round(float(e.get("dur_s", 0.0)) * 1e6, 3)}
+            n = e.get("n", 1)
+            if n != 1:  # one record standing for n dispatches
+                ev["args"] = {"n": n}
+            out.append(ev)
+        elif kind == "counter":
+            ckey = (pid, name)
+            cum[ckey] = cum.get(ckey, 0) + e.get("inc", 1)
+            out.append({"name": name, "ph": "C", "pid": pid,
+                        "ts": round((float(e["t"]) - t0) * 1e6, 3),
+                        "args": {"total": cum[ckey]}})
+        elif kind == "gauge":
+            out.append({"name": name, "ph": "C", "pid": pid,
+                        "ts": round((float(e["t"]) - t0) * 1e6, 3),
+                        "args": {"value": e.get("value", 0.0)}})
+        elif kind == "meta":
+            out.append({"name": name, "ph": "i", "s": "p", "pid": pid,
+                        "tid": tid_for(pid, "main"),
+                        "ts": round((float(e["t"]) - t0) * 1e6, 3),
+                        "args": dict(e.get("fields") or {})})
+
+    for pid in sorted({p for (p, _) in tids} | {int(e.get("rank", 0))
+                                                for e in events}):
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": f"rank {pid}"}})
+    for (pid, track), tid in sorted(tids.items()):
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": track}})
+    return out
+
+
+def chrome_trace(events: Iterable[dict]) -> dict:
+    """The full JSON-object trace format Perfetto/chrome accept."""
+    return {"traceEvents": trace_events(events), "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(events: Iterable[dict], path: str) -> int:
+    """Write the trace to ``path``; returns the event count."""
+    doc = chrome_trace(events)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["traceEvents"])

@@ -60,6 +60,14 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
                              "events + summary JSON; per-rank files on "
                              "multi-host, summary from process 0 only — "
                              "fold with scripts/telemetry_report.py)")
+    parser.add_argument("--obs-port", type=int, default=0, dest="obs_port",
+                        metavar="PORT",
+                        help="live observability endpoint: rank 0 serves "
+                             "GET /metrics (Prometheus text, all ranks "
+                             "folded from --telemetry-dir snapshots) and "
+                             "/healthz on 127.0.0.1:PORT while the run is "
+                             "alive (telemetry/obs.py; 0 = off, no "
+                             "network bind)")
     if train:
         # multi-host (the reference's unscripted KVStore('dist_sync') tier,
         # scripted here — parallel/distributed.py): every process runs the
@@ -230,6 +238,25 @@ def setup_parallel(args):
             "multi-process run resolved to a single-device plan; pass "
             "--devices covering every host's devices (or 0 for all)")
     return plan, pidx, pcount
+
+
+def start_observability(args, driver: str, rank: int = 0, world: int = 1,
+                        run_meta: Optional[dict] = None,
+                        configure_telemetry: bool = False):
+    """Build the driver's :class:`~mx_rcnn_tpu.telemetry.obs.ObsPlane`
+    from the common flags.  Inert (zero binds, zero threads, NULL
+    telemetry untouched) unless ``--obs-port`` is set — or
+    ``configure_telemetry=True`` and ``--telemetry-dir`` is set, for
+    drivers whose sink isn't owned by ``fit`` (test/serve/bench): the
+    plane then also owns configure/summary/shutdown.  Call ``close()``
+    (ideally in a finally) when the run ends."""
+    from mx_rcnn_tpu.telemetry.obs import ObsPlane
+
+    meta = {"driver": driver, **(run_meta or {})}
+    return ObsPlane(port=getattr(args, "obs_port", 0) or 0,
+                    telemetry_dir=getattr(args, "telemetry_dir", "") or "",
+                    rank=rank, world=world, run_meta=meta,
+                    configure_telemetry=configure_telemetry)
 
 
 def check_dist_loader(plan, batch_size: int, pcount: int, pidx: int) -> None:

@@ -204,9 +204,16 @@ class PreemptionGuard:
             signal.raise_signal(signum)
             return
         self._requested = True
+        name = signal.Signals(signum).name
+        # flight-record NOW: if the clean path never reaches its boundary
+        # (hung save, wedged loader) this dump is all the post-mortem gets.
+        # dump_flight is handler-safe: its lock acquire is bounded, so
+        # interrupting a thread inside the sink degrades instead of
+        # deadlocking.
+        telemetry.get().dump_flight("preempt_signal", signal=name)
         logger.warning("received %s — saving a step checkpoint at the next "
                        "step boundary, then exiting cleanly (send again to "
-                       "kill immediately)", signal.Signals(signum).name)
+                       "kill immediately)", name)
 
     def __enter__(self):
         if threading.current_thread() is not threading.main_thread():

@@ -422,6 +422,8 @@ def fit(cfg: Config, model, params, train_loader,
         tel.counter("train/nan_detected")
         tel.meta("nan_detected", epoch=int(ep), consumed=int(cur),
                  policy=res.nan_policy)
+        tel.dump_flight("nan_detected", epoch=int(ep), consumed=int(cur),
+                        policy=res.nan_policy)
         logger.warning("non-finite loss/gradients detected (epoch %d, "
                        "batch %d, policy=%s)", ep, cur, res.nan_policy)
         if res.nan_policy == "skip":
@@ -605,6 +607,10 @@ def fit(cfg: Config, model, params, train_loader,
                 if ckpt is not None:
                     save_step_ckpt(epoch, cur)
                 tel.counter("train/preempted")
+                # flight-record the shutdown at the safe boundary (the
+                # signal handler's own dump has no step context)
+                tel.dump_flight("preempted", epoch=epoch,
+                                consumed=int(cur))
                 preempted = True
             for j in range(n_b):
                 speedo_cb(epoch, consumed + j, bank.format())

@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Observability-plane smoke: a synthetic train with --obs-port on, scraped
+# over real HTTP WHILE it runs; then the trace export and the perf gate's
+# format check over the checked-in bench trajectory.
+#
+#   bash script/obs_smoke.sh            # defaults: port 8377, /tmp dirs
+#   OBS_PORT=9000 bash script/obs_smoke.sh
+set -e
+dir=${TELEMETRY_DIR:-/tmp/mxr_obs_smoke}
+port=${OBS_PORT:-8377}
+rm -rf "$dir"
+
+# trace mode on so the span events carry wall-clock starts for the
+# timeline export below
+MXR_TELEMETRY_TRACE=1 python train_end2end.py --network resnet50 \
+  --synthetic --synthetic_images 8 --prefix /tmp/mxr_obs_smoke_ckpt \
+  --end_epoch 1 --num-steps 4 --frequent 1 \
+  --telemetry-dir "$dir" --obs-port "$port" "$@" &
+train_pid=$!
+trap 'kill $train_pid 2>/dev/null || true' EXIT
+
+# poll /metrics until the server is up and the first step's families are
+# there (train/loader_wait is recorded before the first dispatch even
+# finishes compiling, so a mid-run scrape always has it)
+scrape=""
+for _ in $(seq 1 120); do
+  if scrape=$(curl -sf "http://127.0.0.1:$port/metrics" 2>/dev/null) \
+     && grep -q "mxr_train_loader_wait_seconds_total" <<<"$scrape"; then
+    break
+  fi
+  scrape=""
+  sleep 0.5
+done
+test -n "$scrape" || { echo "obs_smoke: never scraped /metrics mid-run" >&2; exit 1; }
+grep -q 'mxr_up{rank="0"} 1' <<<"$scrape"
+grep -q 'mxr_train_loader_wait_seconds_total{rank="0"}' <<<"$scrape"
+curl -sf "http://127.0.0.1:$port/healthz" | grep -q '"status": "ok"'
+echo "obs_smoke: live scrape OK"
+
+wait $train_pid
+trap - EXIT
+test -f "$dir/events_rank0.jsonl"
+test -f "$dir/summary.json"
+
+# the port must be released once the driver exits (plane teardown)
+if curl -sf --max-time 2 "http://127.0.0.1:$port/healthz" >/dev/null 2>&1; then
+  echo "obs_smoke: obs server still bound after exit" >&2; exit 1
+fi
+
+# fold the run into a Perfetto timeline and validate it is real JSON
+python scripts/telemetry_report.py "$dir" --trace "$dir/trace.json"
+python - "$dir/trace.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+events = doc["traceEvents"]
+assert events, "empty trace"
+assert any(e.get("ph") == "X" for e in events), "no span events"
+print(f"obs_smoke: trace OK ({len(events)} events)")
+EOF
+
+# the perf gate must accept the checked-in bench trajectory
+python scripts/perf_gate.py --check-format BENCH_r*.json
+python scripts/perf_gate.py
+echo "obs_smoke: OK"

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Regression gate over the BENCH_*.json trajectory.
+
+  python scripts/perf_gate.py                     # gate BENCH_r*.json in .
+  python scripts/perf_gate.py --dir runs --threshold 0.15
+  python scripts/perf_gate.py --check-format BENCH_r*.json BENCH_BASELINE.json
+
+Prints a per-metric trend table and exits nonzero when the NEWEST
+``vs_baseline`` regresses more than ``--threshold`` (default 10%) below
+the best prior run of the same metric.  Rows with
+``baseline_recorded: true`` carry a null ratio by design (the run
+recorded the baseline it would have compared against — PR-4's
+null-baseline fix) and are skipped, as is any row without a numeric
+``vs_baseline``.
+
+Comparisons never cross ``baseline_method``: BENCH_BASELINE.json holds
+one baseline per dispatch method (staged ``value`` vs chain
+``value_chain``), so a chain-method 1.0 ratio right after a cross-method
+14x is a method switch, not a 14x regression.  Rows without the field
+(the pre-fix trajectory) form their own group.
+
+``--check-format`` only validates that every file parses and every
+extracted row has ``metric``/``value``/``unit`` and a numeric-or-null
+``vs_baseline`` — script/obs_smoke.sh wires it over the checked-in
+trajectory.  Pure stdlib/host-side JSON: no jax import.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+GATE_THRESHOLD = 0.10
+
+
+def load_rows(path: str) -> list:
+    """Extract metric rows from one trajectory artifact.  Shapes seen in
+    the wild: the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
+    (``parsed`` = the last bench JSON line), a bare bench output line, and
+    BENCH_BASELINE.json (``metric``/``value`` but no ``vs_baseline`` —
+    it IS the baseline)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return [doc["parsed"]]
+    if isinstance(doc, dict) and "metric" in doc:
+        return [doc]
+    return []
+
+
+def check_format(paths: list) -> list:
+    """Format errors (empty when every file is a valid trajectory row)."""
+    errors = []
+    for path in paths:
+        try:
+            rows = load_rows(path)
+        except (OSError, ValueError) as e:
+            errors.append(f"{path}: unreadable ({e})")
+            continue
+        if not rows:
+            errors.append(f"{path}: no metric row found (expected "
+                          f"'parsed' or top-level 'metric')")
+            continue
+        for row in rows:
+            for field in ("metric", "value"):
+                if field not in row:
+                    errors.append(f"{path}: row missing '{field}'")
+            if not isinstance(row.get("value", 0.0), (int, float)):
+                errors.append(f"{path}: 'value' not a number: "
+                              f"{row.get('value')!r}")
+            vs = row.get("vs_baseline", None)
+            if vs is not None and not isinstance(vs, (int, float)):
+                errors.append(f"{path}: 'vs_baseline' neither numeric "
+                              f"nor null: {vs!r}")
+    return errors
+
+
+def build_series(paths: list) -> dict:
+    """``(metric, baseline_method) → [(file, row)]`` in file order (the
+    BENCH_rNN naming sorts chronologically)."""
+    series: dict = {}
+    for path in paths:
+        for row in load_rows(path):
+            if "vs_baseline" not in row:
+                continue  # BENCH_BASELINE.json: not a trajectory point
+            key = (row.get("metric", "?"), row.get("baseline_method"))
+            series.setdefault(key, []).append((path, row))
+    return series
+
+
+def gate(series: dict, threshold: float = GATE_THRESHOLD) -> list:
+    """The failures: newest scored run > threshold below the best prior
+    scored run of the same (metric, baseline_method)."""
+    failures = []
+    for (metric, method), hist in sorted(
+            series.items(), key=lambda kv: (kv[0][0], kv[0][1] or "")):
+        scored = [(p, r["vs_baseline"]) for p, r in hist
+                  if isinstance(r.get("vs_baseline"), (int, float))
+                  and not r.get("baseline_recorded")]
+        if len(scored) < 2:
+            continue
+        newest_path, newest = scored[-1]
+        best_prior = max(v for _, v in scored[:-1])
+        if newest < best_prior * (1.0 - threshold):
+            failures.append(
+                f"{metric}"
+                + (f" [{method}]" if method else "")
+                + f": newest vs_baseline {newest:g} "
+                f"({os.path.basename(newest_path)}) is "
+                f"{(1 - newest / best_prior) * 100:.1f}% below the best "
+                f"prior {best_prior:g}")
+    return failures
+
+
+def trend_table(series: dict) -> str:
+    lines = []
+    for (metric, method), hist in sorted(
+            series.items(), key=lambda kv: (kv[0][0], kv[0][1] or "")):
+        label = metric + (f" [{method}]" if method else "")
+        lines.append(label)
+        for path, row in hist:
+            vs = row.get("vs_baseline")
+            note = ""
+            if row.get("baseline_recorded"):
+                note = "  (baseline recorded this run — not scored)"
+            lines.append(
+                f"  {os.path.basename(path):<24} value="
+                f"{row.get('value', float('nan')):>10.3f} "
+                f"{row.get('unit', ''):<9} vs_baseline="
+                f"{'null' if vs is None else f'{vs:g}'}{note}")
+    return "\n".join(lines) if lines else "(no trajectory rows)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="*",
+                    help="trajectory files (default: --dir/BENCH_r*.json)")
+    ap.add_argument("--dir", default=".",
+                    help="where to glob BENCH_r*.json when no paths given")
+    ap.add_argument("--threshold", type=float, default=GATE_THRESHOLD,
+                    help="allowed fractional drop vs the best prior run "
+                         "(default 0.10)")
+    ap.add_argument("--check-format", action="store_true",
+                    dest="check_format",
+                    help="only validate the files parse as trajectory "
+                         "rows; no gating")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or sorted(glob.glob(
+        os.path.join(args.dir, "BENCH_r*.json")))
+    if not paths:
+        print("perf_gate: no BENCH_*.json files found", file=sys.stderr)
+        return 2
+
+    if args.check_format:
+        errors = check_format(paths)
+        for e in errors:
+            print(f"perf_gate: FORMAT {e}", file=sys.stderr)
+        if not errors:
+            print(f"perf_gate: {len(paths)} file(s) well-formed")
+        return 1 if errors else 0
+
+    series = build_series(paths)
+    print(trend_table(series))
+    failures = gate(series, args.threshold)
+    for f in failures:
+        print(f"perf_gate: REGRESSION {f}", file=sys.stderr)
+    if not failures:
+        print(f"perf_gate: OK ({len(paths)} run(s), threshold "
+              f"{args.threshold * 100:.0f}%)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,7 @@ BENCH_*.json-compatible summary.
   python scripts/telemetry_report.py a/events_rank0.jsonl b/events_rank0.jsonl
   python scripts/telemetry_report.py RUN_DIR --json agg.json   # aggregate out
   python scripts/telemetry_report.py RUN_DIR --bench           # metric rows
+  python scripts/telemetry_report.py RUN_DIR --trace out.json  # Perfetto
 
 Accepts any mix of run directories (expanded to every events_rank*.jsonl
 inside — the multi-host layout) and explicit event files; multiple runs
@@ -42,12 +43,22 @@ def main():
     ap.add_argument("--bench", action="store_true",
                     help="print one BENCH-compatible JSON line per rate "
                          "gauge instead of the table")
+    ap.add_argument("--trace", default="",
+                    help="also fold the events into Chrome/Perfetto "
+                         "trace_event JSON here (open in "
+                         "https://ui.perfetto.dev)")
     args = ap.parse_args()
 
-    summary = aggregate(load_events(args.paths))
+    events = load_events(args.paths)
+    summary = aggregate(events)
     if args.json:
         with open(args.json, "w") as f:
             json.dump(summary, f, indent=1, sort_keys=True)
+    if args.trace:
+        from mx_rcnn_tpu.telemetry.trace import write_chrome_trace
+
+        n = write_chrome_trace(events, args.trace)
+        print(f"wrote {n} trace events to {args.trace}")
     if args.bench:
         for row in bench_rows(summary):
             print(json.dumps(row))

@@ -32,7 +32,8 @@ from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.serve import ServeEngine, ServeOptions, make_server, warmup
 from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
-                                      eval_params_from_args)
+                                      eval_params_from_args,
+                                      start_observability)
 
 
 def parse_args():
@@ -73,12 +74,14 @@ def main(args):
     cfg = config_from_args(args, train=False)
     model = build_model(cfg)
     params = eval_params_from_args(args, cfg, model)
-    if args.telemetry_dir:
-        telemetry.configure(args.telemetry_dir,
-                            run_meta={"driver": "serve",
-                                      "network": args.network,
-                                      "serve_batch": args.serve_batch,
-                                      "max_delay_ms": args.max_delay_ms})
+    # the plane owns the sink (configure → summary → shutdown) and, with
+    # --obs-port, the live Prometheus endpoint; the frontend's own
+    # /metrics keeps serving regardless (JSON + ?format=prom)
+    obs = start_observability(args, "serve",
+                              run_meta={"network": args.network,
+                                        "serve_batch": args.serve_batch,
+                                        "max_delay_ms": args.max_delay_ms},
+                              configure_telemetry=True)
     predictor = Predictor(model, params, cfg)
     engine = ServeEngine(predictor, cfg, ServeOptions(
         batch_size=args.serve_batch, max_delay_ms=args.max_delay_ms,
@@ -94,8 +97,16 @@ def main(args):
     # the signal handlers set — shutdown() called from the serving thread
     # itself would deadlock its poll loop
     done = threading.Event()
+
+    def _on_signal(signum, frame):
+        # flight-record the shutdown before draining — the ring holds the
+        # last serve/* events if anything hangs past this point
+        telemetry.get().dump_flight(
+            "preempt_signal", signal=signal.Signals(signum).name)
+        done.set()
+
     for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: done.set())
+        signal.signal(sig, _on_signal)
     t = threading.Thread(target=server.serve_forever, name="serve-http",
                          daemon=True)
     t.start()
@@ -107,9 +118,7 @@ def main(args):
     logger.info("shutting down: %s", engine.metrics()["counters"])
     server.shutdown()
     engine.stop()
-    if args.telemetry_dir:
-        telemetry.get().write_summary(extra={"serve": engine.metrics()})
-        telemetry.shutdown()
+    obs.close(extra={"serve": engine.metrics()})
 
 
 if __name__ == "__main__":

@@ -7,13 +7,13 @@ from __future__ import annotations
 
 import argparse
 
-from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.data import TestLoader
 from mx_rcnn_tpu.eval import Predictor, pred_eval
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
-                                      get_imdb, load_eval_params, make_plan)
+                                      get_imdb, load_eval_params, make_plan,
+                                      start_observability)
 
 
 def parse_args():
@@ -49,20 +49,20 @@ def test_rcnn(args):
             f"--batch_images {bs} must divide by the mesh's data dimension "
             f"{n_data} (the flag is GLOBAL images per step, like train)")
     predictor = Predictor(model, params, cfg, plan=plan)
-    if getattr(args, "telemetry_dir", ""):
-        # eval is single-process (Predictor enforces it), so rank 0 / world
-        # 1 and the summary always belongs to this process
-        telemetry.configure(args.telemetry_dir,
-                            run_meta={"driver": "test", "network": args.network,
-                                      "batch_size": bs})
-    loader = TestLoader(roidb, cfg, batch_size=bs)
-    stats = pred_eval(predictor, loader, imdb, thresh=args.thresh,
-                      vis=args.vis, with_masks=cfg.network.HAS_MASK,
-                      det_cache=args.dets_cache or None)
-    if getattr(args, "telemetry_dir", ""):
-        path = telemetry.get().write_summary()
-        logger.info("wrote telemetry summary to %s", path)
-        telemetry.shutdown()
+    # eval is single-process (Predictor enforces it), so rank 0 / world 1
+    # and the summary always belongs to this process; the plane owns the
+    # sink lifecycle (and the /metrics endpoint when --obs-port is set)
+    obs = start_observability(args, "test",
+                              run_meta={"network": args.network,
+                                        "batch_size": bs},
+                              configure_telemetry=True)
+    try:
+        loader = TestLoader(roidb, cfg, batch_size=bs)
+        stats = pred_eval(predictor, loader, imdb, thresh=args.thresh,
+                          vis=args.vis, with_masks=cfg.network.HAS_MASK,
+                          det_cache=args.dets_cache or None)
+    finally:
+        obs.close()
 
     def flat(d, prefix=""):
         out = {}

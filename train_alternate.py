@@ -23,7 +23,8 @@ from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
                                       get_imdb, get_train_roidb,
-                                      init_or_load_params)
+                                      init_or_load_params,
+                                      start_observability)
 from mx_rcnn_tpu.tools.test_rpn import test_rpn
 from mx_rcnn_tpu.tools.train_rcnn import train_rcnn
 from mx_rcnn_tpu.tools.train_rpn import train_rpn
@@ -69,29 +70,40 @@ def alternate_train(args):
         a.begin_epoch, a.end_epoch, a.prefix = 0, end_epoch, None
         return a
 
-    logger.info("=== stage 1: train RPN ===")
-    s1 = train_rpn(stage_args(rpn_ep), cfg=cfg, params=params, roidb=roidb)
-    logger.info("=== stage 2: generate proposals ===")
-    roidb = test_rpn(args, cfg=cfg, params=jax.device_get(s1.params),
-                     imdb=imdb, roidb=roidb)
-    logger.info("=== stage 3: train RCNN on proposals ===")
-    s3 = train_rcnn(stage_args(rcnn_ep), cfg=cfg, params=params, roidb=roidb)
-    logger.info("=== stage 4: train RPN round 2 (shared conv frozen) ===")
-    s4 = train_rpn(stage_args(rpn_ep), cfg=cfg,
-                   params=jax.device_get(s3.params), roidb=roidb,
-                   frozen_shared=True)
-    logger.info("=== stage 5: proposals round 2 ===")
-    roidb = test_rpn(args, cfg=cfg, params=jax.device_get(s4.params),
-                     imdb=imdb, roidb=roidb)
-    logger.info("=== stage 6: train RCNN round 2 (shared conv frozen) ===")
-    s6 = train_rcnn(stage_args(rcnn_ep), cfg=cfg,
-                    params=jax.device_get(s4.params), roidb=roidb,
-                    frozen_shared=True)
-    logger.info("=== stage 7: combine_model ===")
-    final = combine_model(jax.device_get(s4.params), jax.device_get(s6.params))
-    mgr = CheckpointManager(args.prefix)
-    mgr.save_epoch(args.end_epoch, final, cfg, step=0)
-    logger.info("combined checkpoint saved to %s", args.prefix)
+    # one obs plane across every stage (inert without --obs-port) — the
+    # per-stage fits reuse the plane's sink instead of opening their own,
+    # so a scrape mid-run sees the whole alternate sequence accumulate
+    obs = start_observability(args, "train_alternate",
+                              run_meta={"network": args.network})
+    try:
+        logger.info("=== stage 1: train RPN ===")
+        s1 = train_rpn(stage_args(rpn_ep), cfg=cfg, params=params,
+                       roidb=roidb)
+        logger.info("=== stage 2: generate proposals ===")
+        roidb = test_rpn(args, cfg=cfg, params=jax.device_get(s1.params),
+                         imdb=imdb, roidb=roidb)
+        logger.info("=== stage 3: train RCNN on proposals ===")
+        s3 = train_rcnn(stage_args(rcnn_ep), cfg=cfg, params=params,
+                        roidb=roidb)
+        logger.info("=== stage 4: train RPN round 2 (shared conv frozen) ===")
+        s4 = train_rpn(stage_args(rpn_ep), cfg=cfg,
+                       params=jax.device_get(s3.params), roidb=roidb,
+                       frozen_shared=True)
+        logger.info("=== stage 5: proposals round 2 ===")
+        roidb = test_rpn(args, cfg=cfg, params=jax.device_get(s4.params),
+                         imdb=imdb, roidb=roidb)
+        logger.info("=== stage 6: train RCNN round 2 (shared conv frozen) ===")
+        s6 = train_rcnn(stage_args(rcnn_ep), cfg=cfg,
+                        params=jax.device_get(s4.params), roidb=roidb,
+                        frozen_shared=True)
+        logger.info("=== stage 7: combine_model ===")
+        final = combine_model(jax.device_get(s4.params),
+                              jax.device_get(s6.params))
+        mgr = CheckpointManager(args.prefix)
+        mgr.save_epoch(args.end_epoch, final, cfg, step=0)
+        logger.info("combined checkpoint saved to %s", args.prefix)
+    finally:
+        obs.close()
     return final
 
 

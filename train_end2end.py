@@ -29,7 +29,8 @@ from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.tools.common import (CappedLoader, add_common_args,
                                       check_dist_loader, config_from_args,
                                       get_imdb, get_train_roidb,
-                                      init_or_load_params, setup_parallel)
+                                      init_or_load_params, setup_parallel,
+                                      start_observability)
 from mx_rcnn_tpu.train import ResilienceOptions, fit
 
 
@@ -67,16 +68,25 @@ def train_net(args):
 
     model = build_model(cfg)
     params = init_or_load_params(args, cfg, model, batch_size)
-    state = fit(cfg, model, params, loader,
-                begin_epoch=args.begin_epoch, end_epoch=args.end_epoch,
-                plan=plan, prefix=args.prefix, graph="end2end",
-                seed=getattr(args, "seed", 0),
-                frequent=args.frequent, resume=args.resume,
-                profile_dir=getattr(args, "profile", "") or None,
-                telemetry_dir=getattr(args, "telemetry_dir", "") or None,
-                steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
-                fixed_prefixes=cfg.network.FIXED_PARAMS,
-                resilience=ResilienceOptions.from_args(args))
+    # live plane (inert without --obs-port): when it configures the sink,
+    # fit reuses it (owns_tel=False) and the plane writes the summary
+    obs = start_observability(args, "train_end2end", rank=pidx,
+                              world=pcount,
+                              run_meta={"network": args.network,
+                                        "batch_size": batch_size})
+    try:
+        state = fit(cfg, model, params, loader,
+                    begin_epoch=args.begin_epoch, end_epoch=args.end_epoch,
+                    plan=plan, prefix=args.prefix, graph="end2end",
+                    seed=getattr(args, "seed", 0),
+                    frequent=args.frequent, resume=args.resume,
+                    profile_dir=getattr(args, "profile", "") or None,
+                    telemetry_dir=getattr(args, "telemetry_dir", "") or None,
+                    steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
+                    fixed_prefixes=cfg.network.FIXED_PARAMS,
+                    resilience=ResilienceOptions.from_args(args))
+    finally:
+        obs.close()
     return state
 
 
