@@ -515,8 +515,14 @@ def bench_serve(batch: int, network: str = "resnet101"):
                 f.result(timeout=600.0)
             best = max(best or 0.0, wave / (time.time() - t0))
     finally:
+        # latency from the engine's own request-time histogram (submit →
+        # response, over every timed wave) so the BENCH row carries p50/
+        # p99 alongside throughput — "fast but slow-tailed" is visible
+        h = engine.hists["serve/request_time"]
+        p50, p99 = h.quantile(0.5), h.quantile(0.99)
         engine.stop()
-    return best
+    return best, (None if p50 is None else round(p50 * 1e3, 3)), \
+        (None if p99 is None else round(p99 * 1e3, 3))
 
 
 def bench_infer_mask(batch: int, network: str = "resnet101_fpn_mask"):
@@ -628,7 +634,8 @@ def main():
         value = bench_infer_mask(args.batch, args.network)
         metric = "infer_imgs_per_sec_mask_eval"
     elif args.mode == "serve":
-        value = bench_serve(args.batch, args.network)
+        value, serve_p50_ms, serve_p99_ms = bench_serve(args.batch,
+                                                        args.network)
         metric = "serve_imgs_per_sec"
         infer_method = "engine"  # not comparable to forward-only rows
     else:
@@ -694,6 +701,9 @@ def main():
         out["baseline_recorded"] = True
     if infer_method is not None:
         out["method"] = infer_method
+    if args.mode == "serve":
+        out["p50_ms"] = serve_p50_ms
+        out["p99_ms"] = serve_p99_ms
     if tel.enabled:
         tel.gauge(f"bench/{metric}", value)
     obs.close(extra={"bench": out})

@@ -221,8 +221,9 @@ class _Prefetcher:
                 else:
                     t_prod = time.perf_counter()
                     for item in gen:
-                        tel.add("loader/produce",
-                                time.perf_counter() - t_prod)
+                        dt_prod = time.perf_counter() - t_prod
+                        tel.add("loader/produce", dt_prod)
+                        tel.observe("loader/produce", dt_prod)
                         if put is not None:
                             with tel.span("loader/put_transfer"):
                                 item = put(item)

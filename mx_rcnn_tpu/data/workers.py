@@ -395,7 +395,9 @@ class WorkerPool:
         img = np.array(view, copy=True)  # slot freed below — must own
         self._free.put(slot)
         if tel.enabled:
-            tel.add("loader/assembly_wait", time.perf_counter() - t0)
+            dt_wait = time.perf_counter() - t0
+            tel.add("loader/assembly_wait", dt_wait)
+            tel.observe("loader/assembly_wait", dt_wait)
             tel.add(f"loader/worker{t.worker}/produce", meta["dur_s"])
             if meta.get("bad"):
                 tel.counter("loader/bad_record", meta["bad"])

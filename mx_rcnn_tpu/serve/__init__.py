@@ -5,17 +5,22 @@ loader's image-prep chain (``data.prepare_image``), the ``Predictor``'s
 jitted bucket programs, and the shared ``ops/postprocess`` block that
 ``pred_eval`` scores with.
 
-* ``engine``   — async queue + bucket-aware dynamic batcher (deadline
+* ``engine``     — async queue + bucket-aware dynamic batcher (deadline
   flush, partial-batch padding, bounded-queue backpressure).
-* ``frontend`` — stdlib HTTP endpoints (``/predict``, ``/healthz``,
+* ``frontend``   — stdlib HTTP endpoints (``/predict``, ``/healthz``,
   ``/metrics``) over TCP or a Unix socket, plus a stdio mode.
-* ``warmup``   — eager compilation of every (bucket, batch) program so
+* ``warmup``     — eager compilation of every (bucket, batch) program so
   the first request never pays XLA compile.
+* ``controller`` — SLO-driven admission control: adapts per-bucket flush
+  batch/delay toward ``--target-p99-ms`` off the engine's own latency
+  histograms and sheds load when the queue trend predicts misses.
 
 Driver: top-level ``serve.py``; load generator: ``scripts/loadgen.py``;
-throughput: ``bench.py --mode serve``; smoke: ``script/serve_smoke.sh``.
+throughput: ``bench.py --mode serve``; smoke: ``script/serve_smoke.sh``
+and ``script/slo_smoke.sh``.
 """
 
+from mx_rcnn_tpu.serve.controller import ControllerOptions, SLOController
 from mx_rcnn_tpu.serve.engine import (DeadlineExceededError, RejectedError,
                                       ServeEngine, ServeFuture, ServeOptions)
 from mx_rcnn_tpu.serve.frontend import (encode_image_payload, make_server,
@@ -23,5 +28,6 @@ from mx_rcnn_tpu.serve.frontend import (encode_image_payload, make_server,
 from mx_rcnn_tpu.serve.warmup import warmup
 
 __all__ = ["ServeEngine", "ServeOptions", "ServeFuture", "RejectedError",
-           "DeadlineExceededError", "make_server", "run_stdio",
-           "unix_http_request", "encode_image_payload", "warmup"]
+           "DeadlineExceededError", "SLOController", "ControllerOptions",
+           "make_server", "run_stdio", "unix_http_request",
+           "encode_image_payload", "warmup"]

@@ -34,9 +34,26 @@ Telemetry (whatever sink is active): per-request ``serve/queue_wait``
 spans; per-batch ``serve/forward`` / ``serve/readback`` /
 ``serve/postprocess`` spans and ``serve/batch_fill`` / ``serve/pad_ratio``
 gauges; ``serve/requests`` / ``serve/batches`` / ``serve/rejected`` /
-``serve/deadline_exceeded`` / ``serve/recompile`` counters.  The same
-counts are mirrored in :attr:`ServeEngine.counters` so ``/metrics`` works
-with telemetry disabled.
+``serve/shed`` / ``serve/deadline_exceeded`` / ``serve/recompile``
+counters.  The same counts are mirrored in :attr:`ServeEngine.counters`
+so ``/metrics`` works with telemetry disabled — and likewise the engine
+keeps its own latency :class:`~mx_rcnn_tpu.telemetry.Hist` instances
+(queue wait / service time / end-to-end request time, plus per-bucket
+request time), which is what lets ``serve/controller.py`` read live p99s
+and ``/metrics`` expose histogram families in every configuration.
+
+SLO hooks (driven by :class:`~mx_rcnn_tpu.serve.controller.SLOController`
+when ``--target-p99-ms`` is set, inert otherwise):
+
+* per-bucket policy — ``set_bucket_policy(key, max_batch, max_delay_ms)``
+  lowers a bucket's flush threshold below ``opts.batch_size`` and/or its
+  flush delay below ``opts.max_delay_ms``.  The COMPILED program shape is
+  untouched: a smaller ``max_batch`` just flushes earlier and pads more,
+  trading fill for head-of-line latency without any recompile.
+* admission limit — ``set_admit_limit(n)`` sheds submits (503, counted
+  as ``serve/shed``, distinct from queue-full ``serve/rejected``) once
+  queue depth reaches ``n`` < ``max_queue``, so the controller can cut
+  intake BEFORE the queue trend turns into deadline misses.
 """
 
 from __future__ import annotations
@@ -49,6 +66,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.telemetry import Hist
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.image import bucket_shape
 from mx_rcnn_tpu.data.loader import prepare_image
@@ -133,13 +151,15 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("image", "im_info", "t_enqueue", "deadline", "future")
+    __slots__ = ("image", "im_info", "t_enqueue", "deadline", "bucket",
+                 "future")
 
-    def __init__(self, image, im_info, t_enqueue, deadline):
+    def __init__(self, image, im_info, t_enqueue, deadline, bucket=None):
         self.image = image          # bucket-padded network input
         self.im_info = im_info
         self.t_enqueue = t_enqueue  # monotonic
         self.deadline = deadline    # monotonic instant or None
+        self.bucket = bucket        # (H, W) routing key, for per-bucket obs
         self.future = ServeFuture()
 
 
@@ -166,9 +186,26 @@ class ServeEngine:
         # each bucket shape is the compile
         self._seen_shapes = set()
         self.counters = {"requests": 0, "served": 0, "batches": 0,
-                         "rejected": 0, "deadline_exceeded": 0,
+                         "rejected": 0, "shed": 0, "deadline_exceeded": 0,
                          "recompiles": 0, "warmup_programs": 0}
         self._pool = None  # prep worker pool (opts.prep_workers > 0)
+        # engine-authoritative latency distributions (same contract as
+        # self.counters: live even with telemetry off — the controller's
+        # and /metrics' source of truth); Hist has its own lock, so these
+        # are observed OUTSIDE self._lock
+        self.hists: Dict[str, Hist] = {
+            "serve/queue_wait": Hist(),
+            "serve/service_time": Hist(),
+            "serve/request_time": Hist(),
+        }
+        self._bucket_hists: Dict[str, Hist] = {}  # "HxW" -> request_time
+        # SLO-controller policy overrides (None/absent = configured opts);
+        # max_batch is a FLUSH THRESHOLD <= opts.batch_size — the padded
+        # program shape never changes, so no recompiles
+        self._bucket_batch: Dict[Tuple[int, int], int] = {}
+        self._bucket_delay_ms: Dict[Tuple[int, int], float] = {}
+        self._admit_limit: Optional[int] = None
+        self.controller = None  # set by SLOController.start()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -217,6 +254,75 @@ class ServeEngine:
         with self._lock:
             return sum(len(q) for q in self._queues.values())
 
+    # -- SLO-controller policy surface -----------------------------------
+
+    def bucket_policy(self, key: Tuple[int, int]) -> Tuple[int, float]:
+        """Effective (flush_batch, max_delay_ms) for a bucket — configured
+        opts unless the controller has tightened them."""
+        with self._lock:
+            return (self._bucket_batch.get(key, self.opts.batch_size),
+                    self._bucket_delay_ms.get(key, self.opts.max_delay_ms))
+
+    def set_bucket_policy(self, key: Tuple[int, int],
+                          max_batch: Optional[int] = None,
+                          max_delay_ms: Optional[float] = None):
+        """Override a bucket's flush threshold / delay.  ``max_batch`` is
+        clamped to [1, opts.batch_size] — the compiled shape is fixed, the
+        knob only flushes earlier.  ``None`` leaves a knob unchanged;
+        setting the configured value drops the override."""
+        with self._cond:
+            if max_batch is not None:
+                b = max(1, min(int(max_batch), self.opts.batch_size))
+                if b == self.opts.batch_size:
+                    self._bucket_batch.pop(key, None)
+                else:
+                    self._bucket_batch[key] = b
+            if max_delay_ms is not None:
+                d = max(0.0, float(max_delay_ms))
+                if d == self.opts.max_delay_ms:
+                    self._bucket_delay_ms.pop(key, None)
+                else:
+                    self._bucket_delay_ms[key] = d
+            # a shorter delay may make a parked bucket due immediately
+            self._cond.notify()
+
+    def set_admit_limit(self, limit: Optional[int]):
+        """Shed submits (503) at this queue depth — the controller's
+        early-shed valve.  ``None`` restores plain max_queue backpressure."""
+        with self._lock:
+            self._admit_limit = (None if limit is None
+                                 else max(1, min(int(limit),
+                                                 self.opts.max_queue)))
+
+    def known_buckets(self) -> List[Tuple[int, int]]:
+        """Buckets that have ever queued a request (adaptation targets)."""
+        with self._lock:
+            return sorted(self._queues.keys())
+
+    def latency_hists(self) -> Dict[str, Hist]:
+        """Engine-authoritative latency histograms, global + per-bucket
+        (``serve/request_time/HxW``).  The engine lock only guards the
+        dict copy; Hist contents are internally locked."""
+        out = dict(self.hists)
+        with self._lock:
+            bucket = dict(self._bucket_hists)
+        out.update({f"serve/request_time/{k}": h for k, h in bucket.items()})
+        return out
+
+    def policy(self) -> Dict[str, dict]:
+        """Live effective policy per known bucket (for /metrics)."""
+        with self._lock:
+            keys = sorted(self._queues.keys())
+            out = {}
+            for key in keys:
+                out[f"{key[0]}x{key[1]}"] = {
+                    "max_batch": self._bucket_batch.get(
+                        key, self.opts.batch_size),
+                    "max_delay_ms": self._bucket_delay_ms.get(
+                        key, self.opts.max_delay_ms),
+                }
+            return out
+
     def submit(self, image: np.ndarray,
                deadline_ms: Optional[float] = None) -> ServeFuture:
         """Enqueue one raw RGB HWC image (uint8 or float).  Returns a
@@ -246,13 +352,23 @@ class ServeEngine:
         if deadline_ms is None:
             deadline_ms = self.opts.deadline_ms
         deadline = now + deadline_ms / 1e3 if deadline_ms > 0 else None
-        req = _Request(prepared, im_info, now, deadline)
+        req = _Request(prepared, im_info, now, deadline, bucket=key)
         with self._cond:
             if self._stop:
                 self.counters["rejected"] += 1
                 tel.counter("serve/rejected")
                 raise RejectedError("engine stopped")
             depth = sum(len(q) for q in self._queues.values())
+            if self._admit_limit is not None and depth >= self._admit_limit:
+                # controller-driven early shed: the queue is NOT full, but
+                # its trend predicts deadline misses — refuse now, cheaply,
+                # instead of serving a 504 after a wasted queue residence
+                self.counters["shed"] += 1
+                tel.counter("serve/shed")
+                raise RejectedError(
+                    f"load shed: SLO controller capped admissions at "
+                    f"{self._admit_limit} queued requests ({depth} "
+                    f"pending) — retry with backoff")
             if depth >= self.opts.max_queue:
                 self.counters["rejected"] += 1
                 tel.counter("serve/rejected")
@@ -289,14 +405,19 @@ class ServeEngine:
 
         Full buckets flush first; among due buckets the one whose
         head-of-line request is OLDEST wins — deadline-ordered flushing,
-        so no bucket's traffic can starve another's latency budget."""
-        B = self.opts.batch_size
-        delay = self.opts.max_delay_ms / 1e3
+        so no bucket's traffic can starve another's latency budget.
+
+        "Full" and "due" are judged per bucket against the controller's
+        policy overrides (flush threshold <= opts.batch_size, possibly
+        shortened delay); without a controller both fall back to opts."""
         best_key, best_t, best_full = None, None, False
         wait = None
         for key, q in self._queues.items():
             if not q:
                 continue
+            B = self._bucket_batch.get(key, self.opts.batch_size)
+            delay = self._bucket_delay_ms.get(
+                key, self.opts.max_delay_ms) / 1e3
             head_t = q[0].t_enqueue
             full = len(q) >= B
             if not (full or (now - head_t) >= delay):
@@ -308,6 +429,7 @@ class ServeEngine:
                 best_key, best_t, best_full = key, head_t, full
         if best_key is not None:
             q = self._queues[best_key]
+            B = self._bucket_batch.get(best_key, self.opts.batch_size)
             take, q[:] = q[:B], q[B:]
             return take, None
         return None, wait
@@ -347,6 +469,8 @@ class ServeEngine:
         for r in reqs:
             r.future.queue_wait_s = now - r.t_enqueue
             tel.add("serve/queue_wait", now - r.t_enqueue)
+            self.hists["serve/queue_wait"].observe(now - r.t_enqueue)
+            tel.observe("serve/queue_wait", now - r.t_enqueue)
         # pad partial batches with repeats (the TestLoader recipe); the
         # padded rows never reach a response
         images = np.stack([r.image for r in reqs]
@@ -377,7 +501,28 @@ class ServeEngine:
                                         cfg.TEST.NMS,
                                         cfg.TEST.MAX_PER_IMAGE)
                 r.future._set_result(detections_to_records(dets_pc))
+        # latency distributions: service time once per batch, end-to-end
+        # request time once per request (global + per-bucket family) —
+        # into the engine's own Hists AND the active sink, so the SLO
+        # controller and /metrics see them regardless of telemetry config
+        done = time.monotonic()
+        service_s = done - now
+        self.hists["serve/service_time"].observe(service_s)
+        tel.observe("serve/service_time", service_s)
+        new_bucket_hists = {}
+        for r in reqs:
+            req_s = done - r.t_enqueue
+            self.hists["serve/request_time"].observe(req_s)
+            tel.observe("serve/request_time", req_s)
+            if r.bucket is not None:
+                bk = f"{r.bucket[0]}x{r.bucket[1]}"
+                h = self._bucket_hists.get(bk) or new_bucket_hists.get(bk)
+                if h is None:
+                    h = new_bucket_hists[bk] = Hist()
+                h.observe(req_s)
+                tel.observe(f"serve/request_time/{bk}", req_s)
         with self._lock:
+            self._bucket_hists.update(new_bucket_hists)
             self.counters["batches"] += 1
             self.counters["served"] += len(reqs)
         tel.counter("serve/batches")
@@ -386,9 +531,14 @@ class ServeEngine:
     # -- introspection ---------------------------------------------------
 
     def metrics(self) -> dict:
-        """The ``/metrics`` payload: counters + live queue state."""
+        """The ``/metrics`` payload: counters + live queue state, latency
+        quantiles, effective per-bucket policy, and (when a controller is
+        attached) its live state.  ``self._lock`` is NOT reentrant (the
+        dispatch condition wraps it), so everything that takes its own
+        lock — Hist quantiles, ``policy()``, the controller — runs after
+        the engine lock is released."""
         with self._lock:
-            return {
+            out = {
                 "counters": dict(self.counters),
                 "queue_depth": sum(len(q) for q in self._queues.values()),
                 "buckets": {f"{h}x{w}": len(q)
@@ -397,4 +547,18 @@ class ServeEngine:
                             "max_delay_ms": self.opts.max_delay_ms,
                             "max_queue": self.opts.max_queue,
                             "deadline_ms": self.opts.deadline_ms},
+                "admit_limit": self._admit_limit,
             }
+        latency = {}
+        for name, h in self.hists.items():
+            short = name.split("/", 1)[1]
+            for q, tag in ((0.5, "p50_ms"), (0.99, "p99_ms")):
+                v = h.quantile(q)
+                if v is not None:
+                    latency[f"{short}_{tag}"] = round(v * 1e3, 3)
+        out["latency"] = latency
+        out["policy"] = self.policy()
+        ctrl = self.controller
+        if ctrl is not None:
+            out["controller"] = ctrl.state()
+        return out

@@ -29,13 +29,15 @@ from __future__ import annotations
 
 from typing import Optional
 
-from mx_rcnn_tpu.telemetry.sink import (NULL, RING_SIZE, SCHEMA_VERSION,
-                                        SUMMARY_NAME, NullTelemetry,
-                                        Telemetry)
+from mx_rcnn_tpu.telemetry.sink import (HIST_LE, NULL, RING_SIZE,
+                                        SCHEMA_VERSION, SUMMARY_NAME, Hist,
+                                        NullTelemetry, Telemetry,
+                                        quantile_from_counts)
 
 __all__ = ["Telemetry", "NullTelemetry", "NULL", "RING_SIZE",
-           "SCHEMA_VERSION", "SUMMARY_NAME", "configure", "get",
-           "reset_null", "shutdown"]
+           "SCHEMA_VERSION", "SUMMARY_NAME", "Hist", "HIST_LE",
+           "quantile_from_counts", "configure", "get", "reset_null",
+           "shutdown"]
 
 _active: "NullTelemetry | Telemetry" = NULL
 

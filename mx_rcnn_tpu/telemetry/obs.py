@@ -56,6 +56,7 @@ ENGINE_COUNTER_NAMES = {
     "served": "serve/images",
     "batches": "serve/batches",
     "rejected": "serve/rejected",
+    "shed": "serve/shed",
     "deadline_exceeded": "serve/deadline_exceeded",
     "recompiles": "serve/recompile",
     "warmup_programs": "serve/warmup_programs",
@@ -76,15 +77,22 @@ def prometheus_text(per_rank: dict, ages: Optional[dict] = None) -> str:
       ``mxr_<name>_seconds_max`` (gauge)
     * gauge ``name`` → ``mxr_<name>{rank="N",stat="last|min|max|mean"}``
       — the queue-depth extremes, not just the final sample
+    * hist ``name`` → a native ``mxr_<name>_seconds`` histogram family:
+      cumulative ``_bucket{le="..."}`` lines ending ``le="+Inf"``, plus
+      ``_sum`` and ``_count`` — the shape ``histogram_quantile()`` eats
     * ``mxr_up{rank="N"} 1`` for every rank present, plus
       ``mxr_snapshot_age_seconds`` for ranks folded from snapshot files
       (``ages``: rank → seconds since the snapshot was written).
     """
     counters: dict = {}  # family -> [(rank, value)]
     gauges: dict = {}    # family -> [(rank, labels, value)]
+    hists: dict = {}     # family -> [(rank, hist_dict)]
     for rank in sorted(per_rank):
         s = per_rank[rank] or {}
         gauges.setdefault("mxr_up", []).append((rank, "", 1))
+        for name, h in (s.get("hists") or {}).items():
+            fam = f"mxr_{_prom_name(name)}_seconds"
+            hists.setdefault(fam, []).append((rank, h))
         for name, total in (s.get("counters") or {}).items():
             fam = f"mxr_{_prom_name(name)}_total"
             counters.setdefault(fam, []).append((rank, total))
@@ -117,6 +125,21 @@ def prometheus_text(per_rank: dict, ages: Optional[dict] = None) -> str:
         lines.append(f"# TYPE {fam} gauge")
         for rank, labels, v in gauges[fam]:
             lines.append(f'{fam}{{rank="{rank}"{labels}}} {fmt(v)}')
+    for fam in sorted(hists):
+        lines.append(f"# TYPE {fam} histogram")
+        for rank, h in hists[fam]:
+            cum = 0
+            for le, c in zip(h.get("le", []), h.get("buckets", [])):
+                cum += int(c)
+                lines.append(
+                    f'{fam}_bucket{{rank="{rank}",le="{fmt(float(le))}"}}'
+                    f' {cum}')
+            lines.append(f'{fam}_bucket{{rank="{rank}",le="+Inf"}}'
+                         f' {int(h.get("count", 0))}')
+            lines.append(f'{fam}_sum{{rank="{rank}"}}'
+                         f' {fmt(float(h.get("sum", 0.0)))}')
+            lines.append(f'{fam}_count{{rank="{rank}"}}'
+                         f' {int(h.get("count", 0))}')
     return "\n".join(lines) + "\n"
 
 
@@ -371,8 +394,26 @@ def engine_summary(engine) -> dict:
         "max": max(live.get("max", depth), depth),
         "last": depth,
     }
+    # the engine is authoritative for its latency distributions too — its
+    # Hists observe every request even with telemetry off
+    hists = dict(base.get("hists") or {})
+    for name, h in getattr(engine, "latency_hists", lambda: {})().items():
+        hists[name] = h.to_dict() if hasattr(h, "to_dict") else dict(h)
+    # live SLO-controller state (per-bucket flush batch / max delay and
+    # the admission limit) as point-in-time gauges
+    for name, v in (m.get("controller") or {}).get("gauges", {}).items():
+        gauges[name] = {"count": 1, "mean": v, "min": v, "max": v,
+                        "last": v}
+    for key, pol in (m.get("policy") or {}).items():
+        for stat, v in (("max_batch", pol.get("max_batch")),
+                        ("max_delay_ms", pol.get("max_delay_ms"))):
+            if v is None:
+                continue
+            name = f"slo/bucket_{key}/{stat}"
+            gauges[name] = {"count": 1, "mean": v, "min": v, "max": v,
+                            "last": v}
     return {"spans": base.get("spans") or {}, "counters": counters,
-            "gauges": gauges}
+            "gauges": gauges, "hists": hists}
 
 
 def serve_prometheus(engine) -> str:

@@ -16,7 +16,8 @@ import json
 import os
 from typing import Iterable, List
 
-from mx_rcnn_tpu.telemetry.sink import SCHEMA_VERSION
+from mx_rcnn_tpu.telemetry.sink import (SCHEMA_VERSION, Hist,
+                                        quantile_from_counts)
 
 # the fault-tolerance subsystem's recovery events (train/resilience.py):
 # rendered as their own table section — zeros included — so "did the run
@@ -42,6 +43,7 @@ SERVE_COUNTERS = (
     "serve/images",
     "serve/batches",
     "serve/rejected",
+    "serve/shed",
     "serve/deadline_exceeded",
     "serve/recompile",
     "serve/warmup_programs",
@@ -91,6 +93,7 @@ def aggregate(events: Iterable[dict]) -> dict:
     spans: dict = {}
     counters: dict = {}
     gauges: dict = {}
+    hists: dict = {}
     ranks = set()
     meta: dict = {}
     for e in events:
@@ -121,6 +124,11 @@ def aggregate(events: Iterable[dict]) -> dict:
                 g[2] = min(g[2], v)
                 g[3] = max(g[3], v)
                 g[4] = v
+        elif kind == "hist":
+            h = hists.get(name)
+            if h is None:
+                h = hists[name] = Hist()
+            h.observe(float(e["value"]))
         elif kind == "meta" and name == "run" and not meta:
             meta = dict(e.get("fields", {}))
     return {
@@ -134,6 +142,7 @@ def aggregate(events: Iterable[dict]) -> dict:
         "gauges": {k: {"count": c, "mean": t / max(c, 1), "min": lo,
                        "max": hi, "last": last}
                    for k, (c, t, lo, hi, last) in sorted(gauges.items())},
+        "hists": {k: h.to_dict() for k, h in sorted(hists.items())},
     }
 
 
@@ -182,6 +191,20 @@ def render_table(summary: dict) -> str:
             lines.append(f"{name:<34}{g['count']:>8}{g['mean']:>10.3f}"
                          f"{g['min']:>10.3f}{g['max']:>10.3f}"
                          f"{g['last']:>10.3f}")
+    hists = summary.get("hists", {})
+    if hists:
+        lines.append("")
+        lines.append(f"{'latency':<34}{'count':>8}{'mean_ms':>10}"
+                     f"{'p50_ms':>10}{'p99_ms':>10}")
+        for name, h in hists.items():
+            n = h.get("count", 0)
+            le, buckets = h.get("le", []), h.get("buckets", [])
+            p50 = quantile_from_counts(le, buckets, n, 0.50)
+            p99 = quantile_from_counts(le, buckets, n, 0.99)
+            mean = h.get("sum", 0.0) / max(n, 1)
+            lines.append(f"{name:<34}{n:>8}{mean * 1e3:>10.3f}"
+                         f"{(p50 or 0.0) * 1e3:>10.3f}"
+                         f"{(p99 or 0.0) * 1e3:>10.3f}")
     return "\n".join(lines)
 
 

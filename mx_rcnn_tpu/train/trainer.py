@@ -566,7 +566,12 @@ def fit(cfg: Config, model, params, train_loader,
                     state, metrics = multi_fn(state, stacked, sub)
                     pending = metrics
                     buf = []
-            tel.add("train/dispatch", time.perf_counter() - t_disp, n=n_b)
+            dt_disp = time.perf_counter() - t_disp
+            tel.add("train/dispatch", dt_disp, n=n_b)
+            # per-step latency distribution (dispatch wall over the group,
+            # amortized per step) — the trainer's feed into the histogram
+            # layer, so p99 step time is scrapeable live
+            tel.observe("train/step_time", dt_disp / max(n_b, 1))
             cur = consumed + n_b
             # fetch metrics only at Speedometer cadence: a device→host scalar
             # read stalls the dispatch pipeline (and on tunneled devices costs
@@ -628,8 +633,9 @@ def fit(cfg: Config, model, params, train_loader,
                     b = shard_batch(plan, b)
                 state, metrics = step_fn(state, b, sub)
             pending = metrics
-            tel.add("train/dispatch", time.perf_counter() - t_disp,
-                    n=len(buf))
+            dt_disp = time.perf_counter() - t_disp
+            tel.add("train/dispatch", dt_disp, n=len(buf))
+            tel.observe("train/step_time", dt_disp / max(len(buf), 1))
             buf = []
         if profiling:  # epoch shorter than the stop step: close the trace
             jax.block_until_ready(state)  # pending may be fetched-and-None

@@ -3,32 +3,55 @@
 
   python scripts/loadgen.py --host 127.0.0.1 --port 8321 --n 64 --rate 20
   python scripts/loadgen.py --unix-socket /tmp/serve.sock --n 32 --rate 0
+  python scripts/loadgen.py --port 8321 --scenario steady --scenario bursty \
+      --n 64 --rate 40 --report /tmp/slo.json
 
-Open-loop: request k is FIRED at its scheduled instant k/rate regardless
-of whether earlier responses came back (each request gets its own
-thread), so a slow server accumulates in-flight work and the latency
-distribution shows it — closed-loop generators that wait for responses
-throttle themselves to the server's pace and hide exactly the queueing
-behavior this exists to measure (the coordinated-omission trap).
-``--rate 0`` fires everything at once (burst mode: what backpressure
-tests want).
+Open-loop: request k is FIRED at its scheduled instant regardless of
+whether earlier responses came back (each request gets its own thread),
+so a slow server accumulates in-flight work and the latency distribution
+shows it — closed-loop generators that wait for responses throttle
+themselves to the server's pace and hide exactly the queueing behavior
+this exists to measure (the coordinated-omission trap).  ``--rate 0``
+fires everything at once (burst mode: what backpressure tests want).
 
 Bodies are mixed-size random uint8 images — half landscape, half
 portrait, dimensions jittered per request (seeded) — so the server
 exercises both orientation buckets and real ``resize_to_bucket`` work.
 
-Prints exactly ONE JSON line:
+Scenario profiles (``--scenario``, repeatable — the SLO gate's workload
+vocabulary):
+
+* ``steady``   — uniform arrivals at ``--rate`` (the baseline SLO).
+* ``bursty``   — same average rate, but arrivals clump into bursts of
+  ``--burst`` fired back-to-back: the workload that exposes queue bloat
+  and exercises the SLO controller's shed valve.
+* ``size-mix`` — steady arrivals, adversarial size jitter (full range
+  down to tiny images, random orientation flips): stresses per-bucket
+  routing and batch fill.
+
+Without ``--scenario`` one anonymous steady run prints exactly ONE JSON
+line (the PR-3 contract):
 
   {"requests": N, "status": {"200": k, "503": m, ...}, "p50_ms": ...,
-   "p99_ms": ..., "mean_queue_wait_ms": ..., "imgs_per_sec": ...,
-   "wall_s": ...}
+   "p99_ms": ..., "error_rate": ..., "mean_queue_wait_ms": ...,
+   "imgs_per_sec": ..., "wall_s": ...}
+
+With scenarios, one such line prints per scenario (prefixed by its name
+under ``"scenario"``), and ``--report PATH`` additionally writes the
+machine-readable SLO report ``scripts/perf_gate.py`` gates:
+
+  {"schema": "mxr_slo_report", "version": 1,
+   "scenarios": [{"name": "steady", "requests": ..., "status": {...},
+                  "p50_ms": ..., "p99_ms": ..., "error_rate": ...,
+                  "imgs_per_sec": ..., "wall_s": ...}, ...]}
 
 latency percentiles are over 2xx responses (client-observed, including
 queue wait + forward + post-process + transport); ``imgs_per_sec`` is
-2xx responses over the wall from first fire to last response.  With
-``--assert-2xx`` the exit code is 1 unless every response was 2xx —
-what script/serve_smoke.sh runs.  Pure stdlib + numpy; no jax import,
-safe on a machine with no accelerator.
+2xx responses over the wall from first fire to last response;
+``error_rate`` is the non-2xx fraction.  With ``--assert-2xx`` the exit
+code is 1 unless every response was 2xx, and the failure line on stderr
+names each offending status and its count.  Pure stdlib + numpy; no jax
+import, safe on a machine with no accelerator.
 """
 
 import argparse
@@ -46,16 +69,33 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from mx_rcnn_tpu.serve.frontend import (encode_image_payload,  # noqa: E402
                                         unix_http_request)
 
+REPORT_SCHEMA = "mxr_slo_report"
+REPORT_VERSION = 1
+SCENARIOS = ("steady", "bursty", "size-mix")
 
-def parse_args():
+
+def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--unix-socket", default="", dest="unix_socket",
                     help="target a Unix-socket server instead of TCP")
-    ap.add_argument("--n", type=int, default=32, help="requests to fire")
+    ap.add_argument("--n", type=int, default=32,
+                    help="requests to fire (per scenario)")
     ap.add_argument("--rate", type=float, default=20.0,
-                    help="arrival rate, req/s (0 = fire all at once)")
+                    help="average arrival rate, req/s (0 = fire all at "
+                         "once)")
+    ap.add_argument("--scenario", action="append", choices=SCENARIOS,
+                    dest="scenarios", default=None,
+                    help="run this named profile (repeatable; omit for "
+                         "one anonymous steady run)")
+    ap.add_argument("--burst", type=int, default=8,
+                    help="bursty scenario: requests per burst (fired "
+                         "back-to-back; bursts spaced to keep --rate on "
+                         "average)")
+    ap.add_argument("--report", default="",
+                    help="write the machine-readable SLO report JSON here "
+                         "(scenario mode)")
     ap.add_argument("--deadline-ms", type=float, default=0.0,
                     dest="deadline_ms",
                     help="per-request deadline forwarded to the server "
@@ -69,24 +109,43 @@ def parse_args():
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-request client wait")
     ap.add_argument("--assert-2xx", action="store_true", dest="assert_2xx",
-                    help="exit 1 unless every response was 2xx")
-    return ap.parse_args()
+                    help="exit 1 unless every response was 2xx (stderr "
+                         "names the offending statuses)")
+    return ap.parse_args(argv)
 
 
-def make_payloads(args):
-    rng = np.random.RandomState(args.seed)
+def make_payloads(args, seed=None, size_mix=False):
+    rng = np.random.RandomState(args.seed if seed is None else seed)
     docs = []
     for i in range(args.n):
         h, w = ((args.short, args.long_) if i % 2 == 0
                 else (args.long_, args.short))
-        dh, dw = rng.randint(0, max(min(h, w) // 4, 1), 2)
-        img = rng.randint(0, 255, (max(h - dh, 16), max(w - dw, 16), 3),
-                          dtype=np.uint8)
+        if size_mix:
+            # adversarial mix: anywhere from tiny thumbnails up to the
+            # full size, orientation re-flipped at random
+            h = int(rng.randint(16, max(h, 17)))
+            w = int(rng.randint(16, max(w, 17)))
+        else:
+            dh, dw = rng.randint(0, max(min(h, w) // 4, 1), 2)
+            h, w = max(h - dh, 16), max(w - dw, 16)
+        img = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
         doc = encode_image_payload(img)
         if args.deadline_ms > 0:
             doc["deadline_ms"] = args.deadline_ms
         docs.append(doc)
     return docs
+
+
+def schedule(scenario, n, rate, burst=8):
+    """Fire offsets (seconds from t0) for ``n`` requests.  All profiles
+    hold the same AVERAGE rate so their reports compare; they differ only
+    in arrival clumping."""
+    if rate <= 0:
+        return [0.0] * n
+    if scenario == "bursty":
+        burst = max(int(burst), 1)
+        return [(i // burst) * (burst / rate) for i in range(n)]
+    return [i / rate for i in range(n)]  # steady / size-mix
 
 
 def tcp_request(host, port, doc, timeout):
@@ -100,13 +159,12 @@ def tcp_request(host, port, doc, timeout):
         conn.close()
 
 
-def main():
-    args = parse_args()
-    if bool(args.unix_socket) == bool(args.port):
-        raise SystemExit("pass exactly one of --port / --unix-socket")
-    docs = make_payloads(args)
-
-    results = [None] * args.n  # (status, latency_s, queue_wait_ms)
+def run_requests(args, docs, offsets):
+    """Fire every payload at its offset (open loop); returns
+    ``(results, wall_s)`` where results[i] is
+    ``(status, latency_s, queue_wait_ms, error_str)``."""
+    n = len(docs)
+    results = [None] * n
 
     def fire(i):
         t0 = time.perf_counter()
@@ -127,18 +185,20 @@ def main():
 
     t_start = time.perf_counter()
     threads = []
-    for i in range(args.n):
-        if args.rate > 0:  # open loop: fire on the clock, never on replies
-            lag = t_start + i / args.rate - time.perf_counter()
-            if lag > 0:
-                time.sleep(lag)
+    for i in range(n):
+        lag = t_start + offsets[i] - time.perf_counter()
+        if lag > 0:  # open loop: fire on the clock, never on replies
+            time.sleep(lag)
         th = threading.Thread(target=fire, args=(i,))
         th.start()
         threads.append(th)
     for th in threads:
         th.join()
-    wall = time.perf_counter() - t_start
+    return results, time.perf_counter() - t_start
 
+
+def summarize(results, wall):
+    n = len(results)
     status_counts = {}
     for st, _, _, _ in results:
         status_counts[str(st)] = status_counts.get(str(st), 0) + 1
@@ -146,20 +206,78 @@ def main():
     lat_ms = np.asarray([r[1] for r in ok]) * 1e3
     qw = [r[2] for r in ok if r[2] is not None]
     out = {
-        "requests": args.n,
+        "requests": n,
         "status": dict(sorted(status_counts.items())),
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 3) if ok else None,
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 3) if ok else None,
+        "error_rate": round((n - len(ok)) / max(n, 1), 4),
         "mean_queue_wait_ms": (round(float(np.mean(qw)), 3) if qw else None),
-        "imgs_per_sec": round(len(ok) / wall, 3),
+        "imgs_per_sec": round(len(ok) / wall, 3) if wall > 0 else None,
         "wall_s": round(wall, 3),
     }
     errors = sorted({r[3] for r in results if r[3]})
     if errors:
         out["errors"] = errors[:5]
-    print(json.dumps(out))
-    if args.assert_2xx and len(ok) != args.n:
-        sys.exit(1)
+    return out
+
+
+def assert_2xx_failure(results):
+    """None when every response was 2xx, else the stderr line naming each
+    offending status code and its count (0 = transport error)."""
+    bad = {}
+    for st, _, _, _ in results:
+        if not 200 <= st < 300:
+            bad[st] = bad.get(st, 0) + 1
+    if not bad:
+        return None
+    total = sum(bad.values())
+    parts = ", ".join(
+        f"{ct}x status {st}" if st else f"{ct}x transport error"
+        for st, ct in sorted(bad.items()))
+    errors = sorted({r[3] for r in results if r[3]})
+    msg = (f"loadgen: --assert-2xx failed: {total}/{len(results)} "
+           f"responses were not 2xx ({parts})")
+    if errors:
+        msg += f"; first errors: {'; '.join(errors[:3])}"
+    return msg
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if bool(args.unix_socket) == bool(args.port):
+        raise SystemExit("pass exactly one of --port / --unix-socket")
+
+    scenarios = args.scenarios or [None]
+    report_rows = []
+    all_results = []
+    for idx, scenario in enumerate(scenarios):
+        docs = make_payloads(args, seed=args.seed + idx,
+                             size_mix=(scenario == "size-mix"))
+        offsets = schedule(scenario or "steady", args.n, args.rate,
+                           burst=args.burst)
+        results, wall = run_requests(args, docs, offsets)
+        all_results.extend(results)
+        out = summarize(results, wall)
+        if scenario is not None:
+            out = {"scenario": scenario, **out}
+        if scenario is not None or args.report:
+            report_rows.append({"name": scenario or "default", **{
+                k: v for k, v in out.items()
+                if k in ("requests", "status", "p50_ms", "p99_ms",
+                         "error_rate", "imgs_per_sec", "wall_s")}})
+        print(json.dumps(out))
+
+    if args.report:
+        doc = {"schema": REPORT_SCHEMA, "version": REPORT_VERSION,
+               "scenarios": report_rows}
+        with open(args.report, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+
+    if args.assert_2xx:
+        msg = assert_2xx_failure(all_results)
+        if msg is not None:
+            print(msg, file=sys.stderr)
+            sys.exit(1)
 
 
 if __name__ == "__main__":

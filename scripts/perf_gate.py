@@ -1,17 +1,28 @@
 #!/usr/bin/env python
-"""Regression gate over the BENCH_*.json trajectory.
+"""Regression gate over the BENCH_*.json + SLO_*.json trajectory.
 
-  python scripts/perf_gate.py                     # gate BENCH_r*.json in .
+  python scripts/perf_gate.py                # gate BENCH_r*/SLO_r*.json in .
   python scripts/perf_gate.py --dir runs --threshold 0.15
-  python scripts/perf_gate.py --check-format BENCH_r*.json BENCH_BASELINE.json
+  python scripts/perf_gate.py --check-format BENCH_r*.json SLO_r*.json
 
-Prints a per-metric trend table and exits nonzero when the NEWEST
-``vs_baseline`` regresses more than ``--threshold`` (default 10%) below
-the best prior run of the same metric.  Rows with
-``baseline_recorded: true`` carry a null ratio by design (the run
-recorded the baseline it would have compared against — PR-4's
-null-baseline fix) and are skipped, as is any row without a numeric
-``vs_baseline``.
+Prints a per-metric trend table and exits nonzero when the NEWEST run
+regresses more than ``--threshold`` (default 10%) against the prior
+trajectory of the same metric.  Two row dialects:
+
+* **throughput rows** (bench): higher is better, scored on the
+  ``vs_baseline`` ratio — newest must not fall more than the threshold
+  below the best prior.  Rows with ``baseline_recorded: true`` carry a
+  null ratio by design (the run recorded the baseline it would have
+  compared against — PR-4's null-baseline fix) and are skipped, as is
+  any row without a numeric ``vs_baseline``.
+* **latency/error rows** (``"direction": "down"`` — what an
+  ``mxr_slo_report`` from ``scripts/loadgen.py --report`` expands to):
+  lower is better, scored on the RAW value — newest must not exceed the
+  best (lowest) prior by more than the threshold.  ``abs_slack`` on a
+  row (error_rate uses 0.02) adds an absolute allowance so a best prior
+  of exactly 0 doesn't make any nonzero newest value a failure.  This is
+  the gate that stops "fast but drops bursts" from merging: p50/p99 and
+  error-rate per loadgen scenario are scored alongside imgs/sec.
 
 Comparisons never cross ``baseline_method``: BENCH_BASELINE.json holds
 one baseline per dispatch method (staged ``value`` vs chain
@@ -21,8 +32,9 @@ one baseline per dispatch method (staged ``value`` vs chain
 
 ``--check-format`` only validates that every file parses and every
 extracted row has ``metric``/``value``/``unit`` and a numeric-or-null
-``vs_baseline`` — script/obs_smoke.sh wires it over the checked-in
-trajectory.  Pure stdlib/host-side JSON: no jax import.
+``vs_baseline`` — script/obs_smoke.sh and script/slo_smoke.sh wire it
+over the checked-in trajectory.  Pure stdlib/host-side JSON: no jax
+import.
 """
 
 import argparse
@@ -32,16 +44,46 @@ import os
 import sys
 
 GATE_THRESHOLD = 0.10
+# absolute slack for error-rate rows: a prior trajectory of 0.0 errors
+# would otherwise turn ANY nonzero newest rate into a failure — allow up
+# to 2 percentage points of noise before the relative threshold applies
+ERROR_RATE_ABS_SLACK = 0.02
+
+
+def slo_report_rows(doc: dict) -> list:
+    """Expand an ``mxr_slo_report`` into direction-aware metric rows —
+    one p50/p99/error_rate triple per scenario (null values, e.g. a
+    scenario with zero 2xx responses, are dropped; the error_rate row
+    still scores it)."""
+    rows = []
+    for sc in doc.get("scenarios", []):
+        name = sc.get("name", "?")
+        for field, unit, slack in (("p50_ms", "ms", 0.0),
+                                   ("p99_ms", "ms", 0.0),
+                                   ("error_rate", "fraction",
+                                    ERROR_RATE_ABS_SLACK)):
+            v = sc.get(field)
+            if not isinstance(v, (int, float)):
+                continue
+            row = {"metric": f"slo_{name}_{field}", "value": v,
+                   "unit": unit, "direction": "down"}
+            if slack:
+                row["abs_slack"] = slack
+            rows.append(row)
+    return rows
 
 
 def load_rows(path: str) -> list:
     """Extract metric rows from one trajectory artifact.  Shapes seen in
     the wild: the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
-    (``parsed`` = the last bench JSON line), a bare bench output line, and
+    (``parsed`` = the last bench JSON line), a bare bench output line,
     BENCH_BASELINE.json (``metric``/``value`` but no ``vs_baseline`` —
-    it IS the baseline)."""
+    it IS the baseline), and loadgen's ``mxr_slo_report`` (expanded into
+    lower-is-better rows per scenario)."""
     with open(path) as f:
         doc = json.load(f)
+    if isinstance(doc, dict) and doc.get("schema") == "mxr_slo_report":
+        return slo_report_rows(doc)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return [doc["parsed"]]
     if isinstance(doc, dict) and "metric" in doc:
@@ -60,7 +102,8 @@ def check_format(paths: list) -> list:
             continue
         if not rows:
             errors.append(f"{path}: no metric row found (expected "
-                          f"'parsed' or top-level 'metric')")
+                          f"'parsed', top-level 'metric', or an "
+                          f"mxr_slo_report with scenarios)")
             continue
         for row in rows:
             for field in ("metric", "value"):
@@ -82,7 +125,7 @@ def build_series(paths: list) -> dict:
     series: dict = {}
     for path in paths:
         for row in load_rows(path):
-            if "vs_baseline" not in row:
+            if "vs_baseline" not in row and row.get("direction") != "down":
                 continue  # BENCH_BASELINE.json: not a trajectory point
             key = (row.get("metric", "?"), row.get("baseline_method"))
             series.setdefault(key, []).append((path, row))
@@ -95,6 +138,27 @@ def gate(series: dict, threshold: float = GATE_THRESHOLD) -> list:
     failures = []
     for (metric, method), hist in sorted(
             series.items(), key=lambda kv: (kv[0][0], kv[0][1] or "")):
+        if any(r.get("direction") == "down" for _, r in hist):
+            # lower-is-better: score the raw value against the best
+            # (lowest) prior, with any per-row absolute slack added
+            scored = [(p, r) for p, r in hist
+                      if isinstance(r.get("value"), (int, float))]
+            if len(scored) < 2:
+                continue
+            newest_path, newest_row = scored[-1]
+            newest = newest_row["value"]
+            best_prior = min(r["value"] for _, r in scored[:-1])
+            slack = max((r.get("abs_slack", 0.0) for _, r in scored),
+                        default=0.0)
+            limit = best_prior * (1.0 + threshold) + slack
+            if newest > limit:
+                failures.append(
+                    f"{metric}: newest value {newest:g} "
+                    f"({os.path.basename(newest_path)}) exceeds the best "
+                    f"prior {best_prior:g} by more than "
+                    f"{threshold * 100:.0f}%"
+                    + (f" (+{slack:g} slack)" if slack else ""))
+            continue
         scored = [(p, r["vs_baseline"]) for p, r in hist
                   if isinstance(r.get("vs_baseline"), (int, float))
                   and not r.get("baseline_recorded")]
@@ -124,20 +188,25 @@ def trend_table(series: dict) -> str:
             note = ""
             if row.get("baseline_recorded"):
                 note = "  (baseline recorded this run — not scored)"
+            if row.get("direction") == "down":
+                score = "direction=down"
+            else:
+                score = f"vs_baseline={'null' if vs is None else f'{vs:g}'}"
             lines.append(
                 f"  {os.path.basename(path):<24} value="
                 f"{row.get('value', float('nan')):>10.3f} "
-                f"{row.get('unit', ''):<9} vs_baseline="
-                f"{'null' if vs is None else f'{vs:g}'}{note}")
+                f"{row.get('unit', ''):<9} {score}{note}")
     return "\n".join(lines) if lines else "(no trajectory rows)"
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("paths", nargs="*",
-                    help="trajectory files (default: --dir/BENCH_r*.json)")
+                    help="trajectory files (default: --dir/BENCH_r*.json "
+                         "+ --dir/SLO_r*.json)")
     ap.add_argument("--dir", default=".",
-                    help="where to glob BENCH_r*.json when no paths given")
+                    help="where to glob BENCH_r*.json / SLO_r*.json when "
+                         "no paths given")
     ap.add_argument("--threshold", type=float, default=GATE_THRESHOLD,
                     help="allowed fractional drop vs the best prior run "
                          "(default 0.10)")
@@ -147,10 +216,12 @@ def main(argv=None) -> int:
                          "rows; no gating")
     args = ap.parse_args(argv)
 
-    paths = args.paths or sorted(glob.glob(
-        os.path.join(args.dir, "BENCH_r*.json")))
+    paths = args.paths or (
+        sorted(glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
+        + sorted(glob.glob(os.path.join(args.dir, "SLO_r*.json"))))
     if not paths:
-        print("perf_gate: no BENCH_*.json files found", file=sys.stderr)
+        print("perf_gate: no BENCH_*.json / SLO_*.json files found",
+              file=sys.stderr)
         return 2
 
     if args.check_format:

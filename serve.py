@@ -30,7 +30,8 @@ from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.eval import Predictor
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model
-from mx_rcnn_tpu.serve import ServeEngine, ServeOptions, make_server, warmup
+from mx_rcnn_tpu.serve import (ControllerOptions, ServeEngine, ServeOptions,
+                               SLOController, make_server, warmup)
 from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
                                       eval_params_from_args,
                                       start_observability)
@@ -65,6 +66,19 @@ def parse_args():
                         help="default per-request deadline (504 when "
                              "exceeded; requests may override; <=0 "
                              "disables)")
+    parser.add_argument("--target-p99-ms", type=float, default=0.0,
+                        dest="target_p99_ms",
+                        help="enable the SLO controller: adapt per-bucket "
+                             "flush batch/delay toward this end-to-end "
+                             "request-time p99 and shed load (503) when "
+                             "the queue trend predicts misses (0 = off)")
+    parser.add_argument("--slo-interval-ms", type=float, default=500.0,
+                        dest="slo_interval_ms",
+                        help="SLO controller tick period")
+    parser.add_argument("--slo-window-s", type=float, default=10.0,
+                        dest="slo_window_s",
+                        help="trailing window the controller's p99 is "
+                             "computed over")
     return parser.parse_args()
 
 
@@ -90,6 +104,12 @@ def main(args):
         # pool size (same data/workers.py pool, image-only tasks)
         prep_workers=args.loader_workers or 0)).start()
     warmup(engine)
+    controller = None
+    if args.target_p99_ms > 0:
+        controller = SLOController(engine, ControllerOptions(
+            target_p99_ms=args.target_p99_ms,
+            interval_s=args.slo_interval_ms / 1e3,
+            window_s=args.slo_window_s)).start()
 
     server = make_server(engine, port=args.port or None, host=args.host,
                          unix_socket=args.unix_socket or None)
@@ -117,6 +137,8 @@ def main(args):
     done.wait()
     logger.info("shutting down: %s", engine.metrics()["counters"])
     server.shutdown()
+    if controller is not None:
+        controller.stop()
     engine.stop()
     obs.close(extra={"serve": engine.metrics()})
 
