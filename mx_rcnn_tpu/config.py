@@ -93,9 +93,12 @@ class TrainConfig:
     # stored trace rounds.  Default is "float32" — exact reference (MXNet
     # SGD) momentum semantics; the mini-VOC fixture A/B measured bf16
     # neutral (BASELINE.md round-3 divergence ledger) but fixture
-    # neutrality cannot bound a VOC07/COCO regression, and the win is only
-    # ~0.26 ms/step, so bf16 stays a documented opt-in until A/B'd on a
-    # real dataset.
+    # neutrality cannot bound a VOC07/COCO regression.  The SPEED half of
+    # the claim is now a one-flag measurement — ``python bench.py --mode
+    # train --opt-acc-ab`` runs the chain bench under both dtypes and
+    # emits f32/bf16 ms/step plus ``delta_ms_per_step`` in one JSON row —
+    # so bf16 stays opt-in until that A/B on real TPU hardware plus a
+    # real-dataset accuracy run pins (or retires) the −0.26 ms figure.
     OPT_ACC_DTYPE: str = "float32"
     WARMUP: bool = False
     WARMUP_LR: float = 0.0

@@ -20,10 +20,72 @@ import jax
 import numpy as np
 
 from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.compile import ProgramRegistry
+from mx_rcnn_tpu.compile.registry import INFER_DTYPES
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.loader import TestLoader
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.ops.postprocess import decode_image_boxes, per_class_nms
+
+
+def _variant_params(params, dtype: str):
+    """Transform a float32 param tree into the requested inference
+    variant.  ``bfloat16`` halves param memory/bandwidth (compute already
+    runs in ``cfg.tpu.COMPUTE_DTYPE``); ``int8`` stores per-leaf
+    symmetric-quantized weights as ``(int8 values, f32 scale)`` tuples,
+    dequantized inside the jitted program — a memory-bound-serving
+    variant, tolerance-tested more loosely than bf16."""
+    import jax.numpy as jnp
+
+    if dtype == "float32":
+        return params
+    if dtype == "bfloat16":
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            params)
+
+    def q(x):
+        x = np.asarray(x)
+        if x.dtype.kind != "f" or x.size == 0:
+            return x
+        s = float(np.max(np.abs(x))) / 127.0 or 1.0
+        qv = np.clip(np.rint(x / s), -127, 127).astype(np.int8)
+        return (qv, np.float32(s))
+
+    return jax.tree.map(q, params)
+
+
+def _make_unpack(dtype: str):
+    """The in-program half of :func:`_variant_params` (traced under jit):
+    int8 tuples dequantize back to f32 right before ``model.apply``; the
+    other variants pass through."""
+    import jax.numpy as jnp
+
+    if dtype != "int8":
+        return lambda p: p
+
+    def dq(t):
+        if isinstance(t, tuple):
+            qv, s = t
+            return qv.astype(jnp.float32) * s
+        return t
+
+    return lambda p: jax.tree.map(dq, p,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+
+
+def _make_cast_out(dtype: str):
+    """Low-precision variants cast floating outputs back to f32 inside
+    the program, so the host post-process (numpy NMS, box decode) never
+    sees bf16 — f32 keeps its outputs byte-identical to before."""
+    import jax.numpy as jnp
+
+    if dtype == "float32":
+        return lambda out: out
+    return lambda out: jax.tree.map(
+        lambda x: x.astype(jnp.float32)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, out)
 
 
 class Predictor:
@@ -36,12 +98,30 @@ class Predictor:
     the mesh.  The host loop is unchanged — ``jax.device_get`` gathers the
     sharded outputs.  Batch size must be a multiple of ``plan.n_data``
     (TestLoader pads the tail with repeats already).
+
+    ``dtype``: inference variant — ``"float32"`` (params as loaded, the
+    exact pre-registry behavior), ``"bfloat16"`` (params cast to bf16,
+    outputs cast back to f32 in-program) or ``"int8"`` (symmetric
+    per-leaf weight quantization, dequantized in-program).  Every jitted
+    program routes through a dtype-keyed :class:`ProgramRegistry`, so a
+    bf16 replica's compile bookkeeping and persistent-cache dir are
+    disjoint from f32's.
     """
 
-    def __init__(self, model, params, cfg: Config, plan=None):
+    def __init__(self, model, params, cfg: Config, plan=None,
+                 dtype: str = "float32", cache_base=None):
+        if dtype not in INFER_DTYPES:
+            raise ValueError(f"infer dtype must be one of {INFER_DTYPES}, "
+                             f"got {dtype!r}")
         self.model = model
         self.cfg = cfg
         self.plan = plan
+        self.infer_dtype = dtype
+        self.registry = ProgramRegistry(cfg, dtype=dtype, plan=plan,
+                                        cache_base=cache_base)
+        params = _variant_params(params, dtype)
+        unpack = _make_unpack(dtype)
+        cast_out = _make_cast_out(dtype)
         if plan is not None:
             from mx_rcnn_tpu.parallel import check_spatial
             from mx_rcnn_tpu.parallel.distributed import is_multiprocess_mesh
@@ -74,13 +154,7 @@ class Predictor:
             bsh = None
             jit2 = jax.jit
         self.params = params
-        self._predict = jit2(
-            lambda p, images, im_info: model.apply(
-                {"params": p}, images, im_info, method=model.predict))
-        self._predict_rpn = jit2(
-            lambda p, images, im_info: model.apply(
-                {"params": p}, images, im_info, method=model.predict_rpn))
-        self._masks_from_feats = None
+        self._has_mask = bool(cfg.network.HAS_MASK)
         self._feats = None  # pyramid cache: set by predict(), same batch only
         # cache-identity token: (images shape, monotonic predict counter).
         # predict() stamps it; the cached-mask entry points assert it so a
@@ -88,12 +162,33 @@ class Predictor:
         # round-2 weakness 6 / round-3 weakness 4).
         self._feats_token = None
         self._predict_count = 0
-        self._packed_fns = {}  # (Hp, Wp) -> jitted mask+paste chain
-        if cfg.network.HAS_MASK:
-            self._predict_wf = jit2(
-                lambda p, images, im_info: model.apply(
-                    {"params": p}, images, im_info,
-                    method=model.predict_with_feats))
+
+        # every jitted callable the eval/serve path can dispatch lives in
+        # the registry (lazy, built-once, shared bookkeeping) — these
+        # builders replace the four independent shape-keyed dicts
+        reg = self.registry
+
+        def fwd(method):
+            def f(p, images, im_info):
+                return cast_out(model.apply({"params": unpack(p)}, images,
+                                            im_info, method=method))
+            return f
+
+        reg.register("predict", lambda: jit2(fwd(model.predict)))
+        reg.register("predict_rpn", lambda: jit2(fwd(model.predict_rpn)))
+        reg.register("pyramid", lambda: jax.jit(
+            lambda p, x: model.apply({"params": unpack(p)}, x,
+                                     method=model._pyramid)))
+        if self._has_mask:
+            def fwd_wf(p, images, im_info):
+                out, feats = model.apply({"params": unpack(p)}, images,
+                                         im_info,
+                                         method=model.predict_with_feats)
+                # feats stay in native compute dtype: they only feed the
+                # mask programs below, never the host
+                return cast_out(out), feats
+
+            reg.register("predict_wf", lambda: jit2(fwd_wf))
             # feats sharding is None = inherit from the committed arrays:
             # on a space mesh the cached pyramid comes out of predict()
             # height-sharded, and pinning it to batch() here would make
@@ -101,10 +196,26 @@ class Predictor:
             mjit = (jax.jit if plan is None else
                     partial(jax.jit,
                             in_shardings=(plan.replicated(), None, bsh, bsh)))
-            self._masks_from_feats = mjit(
-                lambda p, feats, boxes, labels: model.apply(
-                    {"params": p}, feats, boxes, labels,
-                    method=model.masks_from_feats))
+            reg.register("masks_from_feats", lambda: mjit(
+                lambda p, feats, boxes, labels: cast_out(model.apply(
+                    {"params": unpack(p)}, feats, boxes, labels,
+                    method=model.masks_from_feats))))
+
+            def build_packed(hp, wp):
+                from mx_rcnn_tpu.ops.mask_paste import paste_masks
+
+                def chain(p, feats, bxs, lbl, bxo):
+                    probs = model.apply({"params": unpack(p)}, feats, bxs,
+                                        lbl, method=model.masks_from_feats)
+                    return paste_masks(probs, bxo, hp, wp)
+
+                if plan is None:
+                    return jax.jit(chain)
+                bsh_ = plan.batch()
+                return jax.jit(chain, in_shardings=(
+                    plan.replicated(), None, bsh_, bsh_, bsh_))
+
+            reg.register("masks_packed", build_packed)
 
     def batch_put(self, batch: dict) -> dict:
         """The TestLoader ``put`` hook: move ``images`` (the only large
@@ -121,14 +232,44 @@ class Predictor:
                          if sh is not None else jax.device_put(batch["images"]))
         return out
 
+    def note_dispatch(self, shape) -> bool:
+        """Registry first-seen accounting for the program ``predict`` will
+        dispatch on ``shape`` — True exactly once per shape per process
+        (the serve engine's recompile-counter signal)."""
+        kind = "predict_wf" if self._has_mask else "predict"
+        return self.registry.note_dispatch(kind, shape)
+
+    def record_compile_seconds(self, shape, seconds: float) -> None:
+        """Companion to :meth:`note_dispatch` for callers (the serve
+        engine) that own the first-dispatch timing themselves."""
+        kind = "predict_wf" if self._has_mask else "predict"
+        self.registry.record_compile_seconds(kind, shape, seconds)
+
+    def _dispatch(self, kind, shape, fn, *args):
+        """Run one registered program; on its first dispatch, block and
+        feed the wall time (compile + first run) to the registry's
+        compile-seconds histogram."""
+        first = self.registry.note_dispatch(kind, shape)
+        t0 = time.perf_counter()
+        out = fn(self.params, *args)
+        if first:
+            jax.block_until_ready(out)
+            self.registry.record_compile_seconds(
+                kind, shape, time.perf_counter() - t0)
+        return out
+
     def predict(self, images, im_info):
         self._predict_count += 1
         self._feats_token = (tuple(images.shape), self._predict_count)
-        if self._masks_from_feats is not None:
-            out, feats = self._predict_wf(self.params, images, im_info)
+        if self._has_mask:
+            out, feats = self._dispatch(
+                "predict_wf", images.shape,
+                self.registry.lookup("predict_wf"), images, im_info)
             self._feats = feats  # reused by predict_masks for this batch
             return out
-        return self._predict(self.params, images, im_info)
+        return self._dispatch("predict", images.shape,
+                              self.registry.lookup("predict"),
+                              images, im_info)
 
     @property
     def feats_token(self):
@@ -146,7 +287,9 @@ class Predictor:
                 f"predictor.feats_token captured right after predict())")
 
     def predict_rpn(self, images, im_info):
-        return self._predict_rpn(self.params, images, im_info)
+        return self._dispatch("predict_rpn", images.shape,
+                              self.registry.lookup("predict_rpn"),
+                              images, im_info)
 
     def predict_masks(self, images, im_info, boxes, labels):
         """boxes in the SCALED frame; → (B, R, 28, 28) probabilities.
@@ -154,17 +297,21 @@ class Predictor:
         assert self.cfg.network.HAS_MASK, "model has no mask head"
         del im_info
         feats = self._pyramid(images)
-        return self._masks_from_feats(self.params, feats, boxes, labels)
+        return self._dispatch("masks_from_feats", boxes.shape,
+                              self.registry.lookup("masks_from_feats"),
+                              feats, boxes, labels)
 
     def predict_masks_cached(self, boxes, labels, token):
         """Mask branch over the pyramid cached by the immediately preceding
         ``predict`` — ONLY valid for that same batch.  ``token`` (required:
         capture :attr:`feats_token` right after the ``predict`` call) pins
         the call to its batch; a reordered caller fails loudly."""
-        assert self._masks_from_feats is not None, "model has no mask head"
+        assert self._has_mask, "model has no mask head"
         assert self._feats is not None, "call predict() on this batch first"
         self._check_token(token)
-        return self._masks_from_feats(self.params, self._feats, boxes, labels)
+        return self._dispatch("masks_from_feats", boxes.shape,
+                              self.registry.lookup("masks_from_feats"),
+                              self._feats, boxes, labels)
 
     def predict_masks_packed(self, boxes, labels, orig_boxes, hp, wp,
                              token):
@@ -173,35 +320,17 @@ class Predictor:
         the masks in the padded (hp, wp) original frame.  One fused jit
         call → (B, R, wp, hp//8) packed bitplanes; the host's only work is
         the C++ RLE encode (``native.rle_encode_packed``)."""
-        from mx_rcnn_tpu.ops.mask_paste import paste_masks
-
-        assert self._masks_from_feats is not None, "model has no mask head"
+        assert self._has_mask, "model has no mask head"
         assert self._feats is not None, "call predict() on this batch first"
         self._check_token(token)
-        fn = self._packed_fns.get((hp, wp))
-        if fn is None:
-            model = self.model
-
-            def chain(p, feats, bxs, lbl, bxo):
-                probs = model.apply({"params": p}, feats, bxs, lbl,
-                                    method=model.masks_from_feats)
-                return paste_masks(probs, bxo, hp, wp)
-
-            if self.plan is None:
-                fn = jax.jit(chain)
-            else:  # feats sharding inherited (see _masks_from_feats note)
-                bsh = self.plan.batch()
-                fn = jax.jit(chain, in_shardings=(
-                    self.plan.replicated(), None, bsh, bsh, bsh))
-            self._packed_fns[(hp, wp)] = fn
-        return fn(self.params, self._feats, boxes, labels, orig_boxes)
+        fn = self.registry.lookup("masks_packed", static=(hp, wp))
+        return self._dispatch("masks_packed",
+                              tuple(boxes.shape) + (hp, wp), fn,
+                              self._feats, boxes, labels, orig_boxes)
 
     def _pyramid(self, images):
-        if not hasattr(self, "_pyr_fn"):
-            self._pyr_fn = jax.jit(
-                lambda p, x: self.model.apply({"params": p}, x,
-                                              method=self.model._pyramid))
-        return self._pyr_fn(self.params, images)
+        return self._dispatch("pyramid", images.shape,
+                              self.registry.lookup("pyramid"), images)
 
 
 def paste_mask(prob: np.ndarray, box: np.ndarray, h: int, w: int) -> np.ndarray:

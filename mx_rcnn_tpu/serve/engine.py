@@ -181,13 +181,19 @@ class ServeEngine:
         self._cond = threading.Condition(self._lock)
         self._stop = False
         self._thread: Optional[threading.Thread] = None
-        # program bookkeeping, the trainer's recompile-tracking recipe:
-        # jit caches one program per input shape, so the first dispatch of
-        # each bucket shape is the compile
+        # program bookkeeping: a real Predictor carries a ProgramRegistry
+        # (one key space for trainer/eval/serve, AOT hit/miss accounting
+        # against the persistent cache); duck-typed predictors fall back
+        # to the original local shape set.  jit caches one program per
+        # input shape, so the first dispatch of each bucket shape is the
+        # compile either way.
+        self.registry = getattr(predictor, "registry", None)
+        self._dtype = getattr(predictor, "infer_dtype", "float32")
         self._seen_shapes = set()
         self.counters = {"requests": 0, "served": 0, "batches": 0,
                          "rejected": 0, "shed": 0, "deadline_exceeded": 0,
-                         "recompiles": 0, "warmup_programs": 0}
+                         "recompiles": 0, "warmup_programs": 0,
+                         f"recompiles_{self._dtype}": 0}
         self._pool = None  # prep worker pool (opts.prep_workers > 0)
         # engine-authoritative latency distributions (same contract as
         # self.counters: live even with telemetry off — the controller's
@@ -480,17 +486,31 @@ class ServeEngine:
         tel.gauge("serve/batch_fill", len(reqs) / B)
         tel.gauge("serve/pad_ratio", pad / B)
         shape = tuple(images.shape)
-        if shape not in self._seen_shapes:
+        if self.registry is not None:
+            first = self.predictor.note_dispatch(shape)
+        else:
+            first = shape not in self._seen_shapes
             self._seen_shapes.add(shape)
+        if first:
             self.counters["recompiles"] += 1
+            self.counters[f"recompiles_{self._dtype}"] += 1
             tel.counter("serve/recompile")
-            tel.meta("recompile", program="serve_predict", shape=list(shape))
+            tel.counter(f"serve/recompile/{self._dtype}")
+            tel.meta("recompile", program="serve_predict", shape=list(shape),
+                     dtype=self._dtype)
+        t_fwd = time.monotonic()
         with tel.span("serve/forward"):
             rois, roi_valid, cls_prob, bbox_deltas, _ = \
                 self.predictor.predict(images, im_info)
         with tel.span("serve/readback"):
             rois, roi_valid, cls_prob, bbox_deltas = jax.device_get(
                 (rois, roi_valid, cls_prob, bbox_deltas))
+        if first and self.registry is not None:
+            # first dispatch of a shape = its compile: the forward +
+            # readback wall is the compile(+first run) cost this program
+            # would charge a cold user request
+            self.predictor.record_compile_seconds(
+                shape, time.monotonic() - t_fwd)
         cfg = self.cfg
         with tel.span("serve/postprocess"):
             for b, r in enumerate(reqs):
@@ -558,6 +578,9 @@ class ServeEngine:
                     latency[f"{short}_{tag}"] = round(v * 1e3, 3)
         out["latency"] = latency
         out["policy"] = self.policy()
+        out["dtype"] = self._dtype
+        if self.registry is not None:
+            out["compile"] = self.registry.snapshot()
         ctrl = self.controller
         if ctrl is not None:
             out["controller"] = ctrl.state()

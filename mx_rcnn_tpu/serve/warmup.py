@@ -1,16 +1,23 @@
-"""Startup warmup: eagerly compile every (bucket, batch) program.
+"""Startup warmup: ensure every (bucket, batch) program is registered
+and ready — compiled from disk (AOT warm start) or from XLA (cold).
 
 XLA compiles the forward on first dispatch of each input shape — tens of
 seconds for the real backbones.  Without warmup the first user request of
 each orientation pays that compile inside its latency budget (and usually
 blows its deadline).  Warmup pushes one full batch of dummy pixels per
 bucket through the REAL engine path — same queue, same padding, same
-post-process — so every program the steady state can dispatch is compiled
+post-process — so every program the steady state can dispatch is ready
 before the frontend accepts traffic, and the engine's recompile counter
-(the trainer's shape-keyed bookkeeping) proves it: after warmup,
-``counters["recompiles"] == counters["warmup_programs"]`` must hold for
-the life of the process (asserted by ``script/serve_smoke.sh`` and
-``tests/test_serve.py``).
+(the program registry's first-dispatch bookkeeping) proves it: after
+warmup, ``counters["recompiles"] == counters["warmup_programs"]`` must
+hold for the life of the process (asserted by ``script/serve_smoke.sh``
+and ``tests/test_serve.py``).
+
+With a persistent program cache (``MXR_PROGRAM_CACHE``), warmup is where
+the AOT win lands: a second boot over a warm cache dir reports
+``compile/aot_hit == warmup_programs`` and zero ``aot_miss`` — every
+"compile" is a disk load, and the logged warmup wall time collapses
+(asserted by ``script/aot_smoke.sh`` and ``tests/test_warmstart.py``).
 """
 
 from __future__ import annotations
@@ -24,28 +31,46 @@ from mx_rcnn_tpu.logger import logger
 
 
 def warmup(engine) -> int:
-    """Compile every (bucket, batch) program through a STARTED engine.
+    """Register + ready every (bucket, batch) program through a STARTED
+    engine.
 
     Submits ``batch_size`` dummy images per orientation (full batches →
     immediate flush, no delay wait) and blocks until served.  Returns the
-    number of programs compiled; stamps it into
-    ``engine.counters["warmup_programs"]`` and the ``serve/warmup_programs``
-    telemetry counter."""
+    number of programs first-dispatched (each either an XLA compile or a
+    persistent-cache load); stamps it into
+    ``engine.counters["warmup_programs"]`` and the
+    ``serve/warmup_programs`` telemetry counter, the warmup wall time
+    into the ``serve/warmup_compile_s`` gauge, and — when the engine's
+    predictor carries a :class:`~mx_rcnn_tpu.compile.ProgramRegistry` —
+    logs the AOT hit/miss split for the warmed programs."""
     assert engine._thread is not None, "start() the engine before warmup"
     short, long_ = engine._scale
     t0 = time.perf_counter()
+    reg = getattr(engine, "registry", None)
     before = engine.counters["recompiles"]
+    aot_before = (dict(reg.counters) if reg is not None else {})
     for h, w in ((short, long_), (long_, short)):  # landscape, portrait
         dummy = np.zeros((h, w, 3), np.uint8)
         futs = [engine.submit(dummy, deadline_ms=0)  # never expire
                 for _ in range(engine.opts.batch_size)]
         for f in futs:
             f.result(timeout=600.0)
+    dt = time.perf_counter() - t0
     compiled = engine.counters["recompiles"] - before
     engine.counters["warmup_programs"] += compiled
-    telemetry.get().counter("serve/warmup_programs", compiled)
-    logger.info("serve warmup: %d program(s) compiled in %.1fs "
-                "(batch=%d, scale=%s)", compiled,
-                time.perf_counter() - t0, engine.opts.batch_size,
-                engine._scale)
+    tel = telemetry.get()
+    tel.counter("serve/warmup_programs", compiled)
+    tel.gauge("serve/warmup_compile_s", dt)
+    if reg is not None:
+        hits = reg.counters["aot_hit"] - aot_before.get("aot_hit", 0)
+        misses = reg.counters["aot_miss"] - aot_before.get("aot_miss", 0)
+        logger.info("serve warmup: %d program(s) ready in %.1fs — "
+                    "%d AOT cache hit(s), %d compile(s) (batch=%d, "
+                    "scale=%s, dtype=%s)", compiled, dt, hits, misses,
+                    engine.opts.batch_size, engine._scale,
+                    getattr(engine, "_dtype", "float32"))
+    else:
+        logger.info("serve warmup: %d program(s) compiled in %.1fs "
+                    "(batch=%d, scale=%s)", compiled, dt,
+                    engine.opts.batch_size, engine._scale)
     return compiled

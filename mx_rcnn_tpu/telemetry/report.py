@@ -167,10 +167,16 @@ def render_table(summary: dict) -> str:
     if counters:
         lines.append("")
         lines.append(f"{'counter':<34}{'total':>8}")
+        # dtype-labeled recompile counters (serve/recompile/bfloat16, ...)
+        # and the program registry's AOT split render inside the serve
+        # health block, not the general section
+        serve_extra = sorted(
+            n for n in counters
+            if n.startswith("serve/recompile/") or n.startswith("compile/"))
         for name, v in counters.items():
             if name in RECOVERY_COUNTERS:
                 continue  # recovery events get their own section below
-            if serving and name in SERVE_COUNTERS:
+            if serving and (name in SERVE_COUNTERS or name in serve_extra):
                 continue  # ditto serve health
             lines.append(f"{name:<34}{v:>8}")
         lines.append("")
@@ -181,6 +187,8 @@ def render_table(summary: dict) -> str:
             lines.append("")
             lines.append(f"{'serve health':<34}{'total':>8}")
             for name in SERVE_COUNTERS:
+                lines.append(f"{name:<34}{counters.get(name, 0):>8}")
+            for name in serve_extra:  # per-dtype recompiles + AOT split
                 lines.append(f"{name:<34}{counters.get(name, 0):>8}")
     gauges = summary.get("gauges", {})
     if gauges:

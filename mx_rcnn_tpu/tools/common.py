@@ -117,7 +117,36 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
                             help="checkpoint epoch to load")
         parser.add_argument("--vis", action="store_true")
         parser.add_argument("--thresh", type=float, default=1e-3)
+        parser.add_argument("--infer-dtype", default="float32",
+                            dest="infer_dtype",
+                            choices=["float32", "bfloat16", "int8"],
+                            help="inference variant: float32 (exact), "
+                                 "bfloat16 (params cast, outputs back to "
+                                 "f32 — tolerance-pinned parity vs f32), "
+                                 "or int8 (symmetric weight quantization)."
+                                 "  Each dtype gets its own program-"
+                                 "registry key space and persistent-cache"
+                                 " dir")
+        parser.add_argument("--program-cache", default="",
+                            dest="program_cache", metavar="DIR",
+                            help="persistent compiled-program cache base "
+                                 "dir (same as the MXR_PROGRAM_CACHE env "
+                                 "var): a second boot over a warm dir "
+                                 "loads its XLA programs from disk "
+                                 "instead of recompiling (machine-, "
+                                 "jax-version- and dtype-keyed subdirs; "
+                                 "see README 'Program registry')")
     return parser
+
+
+def apply_program_cache(args) -> None:
+    """Fold ``--program-cache`` into the ``MXR_PROGRAM_CACHE`` env var
+    (the single knob the :class:`ProgramRegistry` reads) before any
+    Predictor/registry is built.  The flag wins over an inherited env."""
+    import os
+
+    if getattr(args, "program_cache", ""):
+        os.environ["MXR_PROGRAM_CACHE"] = args.program_cache
 
 
 def parse_cfg_overrides(items) -> dict:

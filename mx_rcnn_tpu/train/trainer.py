@@ -385,16 +385,21 @@ def fit(cfg: Config, model, params, train_loader,
         profile_dir = os.path.join(profile_dir,
                                    f"rank{jax.process_index()}")
     # recompile tracking: jit caches one program per (step fn, bucket
-    # shape), so the first dispatch of each pair is the compile.  The set
-    # mirrors that cache (fit builds fresh step fns, so per-fit is exact)
-    # and makes mixed-bucket epochs show their true compile cost in the
-    # telemetry stream instead of as unexplained slow steps.
-    seen_programs = set()
+    # shape), so the first dispatch of each pair is the compile.  The
+    # program registry mirrors that cache (fit builds fresh step fns, so
+    # per-fit is exact), makes mixed-bucket epochs show their true
+    # compile cost in the telemetry stream instead of as unexplained
+    # slow steps, and — with a persistent program cache configured —
+    # accounts each first dispatch as an AOT disk load vs an XLA compile.
+    from mx_rcnn_tpu.compile import ProgramRegistry
+
+    registry = ProgramRegistry(cfg, dtype=cfg.tpu.COMPUTE_DTYPE
+                               if cfg.tpu.COMPUTE_DTYPE in
+                               ("float32", "bfloat16") else "float32",
+                               plan=plan)
 
     def note_dispatch(fn_kind, shape):
-        pkey = (fn_kind, tuple(shape))
-        if pkey not in seen_programs:
-            seen_programs.add(pkey)
+        if registry.note_dispatch(f"train_{fn_kind}", shape):
             tel.counter("train/recompile")
             tel.meta("recompile", program=fn_kind, shape=list(shape))
 

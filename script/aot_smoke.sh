@@ -1,0 +1,97 @@
+#!/usr/bin/env bash
+# AOT warm-start smoke (CPU-friendly): boot serve.py TWICE against one
+# MXR_PROGRAM_CACHE dir and assert the persistent program cache did its
+# job — the first boot cold-compiles every warmup program (aot_miss ==
+# warmup_programs, aot_hit == 0), the second boot compiles ZERO programs
+# at warmup (aot_hit == warmup_programs, aot_miss == 0: every executable
+# loaded from disk) and its cold start (process launch → first 2xx
+# predict) drops materially below the first boot's.  The marker-level
+# half of this claim is pinned machine-independently by
+# tests/test_warmstart.py; the timing bound lives here, outside
+# tier-1, where a wall clock is meaningful.
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${AOT_SMOKE_DIR:-/tmp/mxr_aot_smoke}
+rm -rf "$dir"
+mkdir -p "$dir"
+export MXR_PROGRAM_CACHE="$dir/programs"
+
+boot () {  # $1 = tag; writes $dir/<tag>.json {cold_start_s, counters, compile}
+  tag=$1
+  sock="$dir/$tag.sock"
+  t0=$(python -c 'import time; print(repr(time.time()))')
+  python serve.py --network resnet50 --synthetic --unix-socket "$sock" \
+    --serve-batch 2 --max-delay-ms 50 --max-queue 32 \
+    --cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)" \
+    --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32 &
+  pid=$!
+  trap 'kill "$pid" 2>/dev/null || true' EXIT
+
+  # cold start = launch → healthz 200 → first /predict 2xx; then capture
+  # /metrics (carries the program registry snapshot under "compile")
+  python - "$sock" "$pid" "$t0" "$dir/$tag.json" <<'EOF'
+import json, os, sys, time
+import numpy as np
+from mx_rcnn_tpu.serve import encode_image_payload, unix_http_request
+sock, pid, t0, out = sys.argv[1], int(sys.argv[2]), float(sys.argv[3]), \
+    sys.argv[4]
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("serve.py exited before becoming healthy")
+    try:
+        status, _ = unix_http_request(sock, "GET", "/healthz", timeout=5)
+        if status == 200:
+            break
+    except OSError:
+        pass
+    time.sleep(1)
+else:
+    sys.exit("serve.py never became healthy")
+img = np.random.RandomState(3).randint(0, 255, (80, 110, 3), dtype=np.uint8)
+status, resp = unix_http_request(sock, "POST", "/predict",
+                                 encode_image_payload(img), timeout=300)
+assert status == 200, resp
+cold = time.time() - t0
+status, m = unix_http_request(sock, "GET", "/metrics", timeout=30)
+assert status == 200
+assert "compile" in m, "engine /metrics lacks the registry snapshot"
+json.dump({"cold_start_s": round(cold, 3), "counters": m["counters"],
+           "compile": m["compile"]}, open(out, "w"))
+print(f"{os.path.basename(out)}: cold_start_s={cold:.1f} "
+      f"aot_hit={m['compile']['counters']['aot_hit']} "
+      f"aot_miss={m['compile']['counters']['aot_miss']}")
+EOF
+
+  kill -TERM "$pid"
+  wait "$pid" || true
+  trap - EXIT
+}
+
+boot first
+boot second
+
+python - "$dir/first.json" "$dir/second.json" <<'EOF'
+import json, sys
+first = json.load(open(sys.argv[1]))
+second = json.load(open(sys.argv[2]))
+w1, w2 = (d["counters"]["warmup_programs"] for d in (first, second))
+c1, c2 = first["compile"]["counters"], second["compile"]["counters"]
+
+# boot 1: everything cold — each warmup program was a real XLA compile
+assert w1 >= 2, first["counters"]
+assert c1["aot_miss"] == w1 and c1["aot_hit"] == 0, c1
+
+# boot 2: ZERO warmup compiles — every program loaded from the cache dir
+# boot 1 populated (the PR's acceptance bar)
+assert w2 == w1, (w1, w2)
+assert c2["aot_hit"] == w2 and c2["aot_miss"] == 0, c2
+
+# and the skipped compiles show up where users feel them: cold start
+cold1, cold2 = first["cold_start_s"], second["cold_start_s"]
+assert cold2 < cold1 * 0.9, \
+    f"warm boot {cold2:.1f}s not materially under cold boot {cold1:.1f}s"
+print(f"aot smoke ok: {w2} program(s) warm-started from disk, "
+      f"cold start {cold1:.1f}s -> {cold2:.1f}s")
+EOF

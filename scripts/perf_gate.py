@@ -48,6 +48,12 @@ GATE_THRESHOLD = 0.10
 # would otherwise turn ANY nonzero newest rate into a failure — allow up
 # to 2 percentage points of noise before the relative threshold applies
 ERROR_RATE_ABS_SLACK = 0.02
+# serve-bench startup rows (cold_start_s / warmup_compile_s from
+# bench.py --mode serve) expand into direction=down rows with a couple
+# of seconds of absolute slack — process startup shares the machine
+# with whatever else CI runs, and sub-second jitter on a warm-cache
+# boot must not read as a lost AOT warm start
+STARTUP_ABS_SLACK_S = 2.0
 
 
 def slo_report_rows(doc: dict) -> list:
@@ -85,10 +91,26 @@ def load_rows(path: str) -> list:
     if isinstance(doc, dict) and doc.get("schema") == "mxr_slo_report":
         return slo_report_rows(doc)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
-        return [doc["parsed"]]
+        return startup_rows([doc["parsed"]])
     if isinstance(doc, dict) and "metric" in doc:
-        return [doc]
+        return startup_rows([doc])
     return []
+
+
+def startup_rows(rows: list) -> list:
+    """Expand a serve-bench row's ``cold_start_s`` / ``warmup_compile_s``
+    fields into lower-is-better rows of their own, so the AOT warm-start
+    win is gated exactly like a latency metric: a run that regresses to
+    cold-compiling at boot fails, not just one that serves slowly."""
+    out = list(rows)
+    for row in rows:
+        for field in ("cold_start_s", "warmup_compile_s"):
+            v = row.get(field)
+            if isinstance(v, (int, float)):
+                out.append({"metric": f"{row.get('metric', '?')}_{field}",
+                            "value": v, "unit": "s", "direction": "down",
+                            "abs_slack": STARTUP_ABS_SLACK_S})
+    return out
 
 
 def check_format(paths: list) -> list:

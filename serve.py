@@ -32,7 +32,8 @@ from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.serve import (ControllerOptions, ServeEngine, ServeOptions,
                                SLOController, make_server, warmup)
-from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
+from mx_rcnn_tpu.tools.common import (add_common_args, apply_program_cache,
+                                      config_from_args,
                                       eval_params_from_args,
                                       start_observability)
 
@@ -86,6 +87,7 @@ def main(args):
     if not args.unix_socket and not args.port:
         raise SystemExit("pass --port or --unix-socket")
     cfg = config_from_args(args, train=False)
+    apply_program_cache(args)  # before the Predictor builds its registry
     model = build_model(cfg)
     params = eval_params_from_args(args, cfg, model)
     # the plane owns the sink (configure → summary → shutdown) and, with
@@ -96,7 +98,7 @@ def main(args):
                                         "serve_batch": args.serve_batch,
                                         "max_delay_ms": args.max_delay_ms},
                               configure_telemetry=True)
-    predictor = Predictor(model, params, cfg)
+    predictor = Predictor(model, params, cfg, dtype=args.infer_dtype)
     engine = ServeEngine(predictor, cfg, ServeOptions(
         batch_size=args.serve_batch, max_delay_ms=args.max_delay_ms,
         max_queue=args.max_queue, deadline_ms=args.deadline_ms,

@@ -11,8 +11,9 @@ from mx_rcnn_tpu.data import TestLoader
 from mx_rcnn_tpu.eval import Predictor, pred_eval
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model
-from mx_rcnn_tpu.tools.common import (add_common_args, config_from_args,
-                                      get_imdb, load_eval_params, make_plan,
+from mx_rcnn_tpu.tools.common import (add_common_args, apply_program_cache,
+                                      config_from_args, get_imdb,
+                                      load_eval_params, make_plan,
                                       start_observability)
 
 
@@ -32,6 +33,7 @@ def parse_args():
 
 def test_rcnn(args):
     cfg = config_from_args(args, train=False)
+    apply_program_cache(args)  # before the Predictor builds its registry
     imdb = get_imdb(args, cfg, test=True)
     roidb = imdb.gt_roidb()
     model = build_model(cfg)
@@ -48,7 +50,8 @@ def test_rcnn(args):
         raise ValueError(
             f"--batch_images {bs} must divide by the mesh's data dimension "
             f"{n_data} (the flag is GLOBAL images per step, like train)")
-    predictor = Predictor(model, params, cfg, plan=plan)
+    predictor = Predictor(model, params, cfg, plan=plan,
+                          dtype=args.infer_dtype)
     # eval is single-process (Predictor enforces it), so rank 0 / world 1
     # and the summary always belongs to this process; the plane owns the
     # sink lifecycle (and the /metrics endpoint when --obs-port is set)

@@ -1,0 +1,135 @@
+"""Low-precision inference variants: bf16 parity vs f32, per-dtype
+zero-steady-state-recompile, int8 structural sanity.
+
+The bf16 "variant" casts float params to bfloat16 host-side and casts
+outputs back to f32 in-program; compute is already COMPUTE_DTYPE (bf16
+by default), so the only delta vs the f32 path is weight storage — the
+parity tolerances below pin that delta.  Parity is detection-RECORD
+matching, not tensor allclose: every confident f32 detection must have
+a bf16 twin (same class, score within 0.04, box within 4 px) and vice
+versa, the invariant a serving swap to ``--infer-dtype bfloat16``
+actually relies on.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import prepare_image
+from mx_rcnn_tpu.eval import Predictor
+from mx_rcnn_tpu.models import build_model, init_params
+from mx_rcnn_tpu.ops.postprocess import (decode_image_boxes,
+                                         detections_to_records,
+                                         per_class_nms)
+from mx_rcnn_tpu.serve import ServeEngine, ServeOptions, warmup
+from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
+
+SCORE_MARGIN = 0.03   # dets this close to THRESH may flip in/out — skip
+SCORE_ATOL = 0.04
+BBOX_ATOL_PX = 4.0
+
+
+def tiny_cfg():
+    cfg = generate_config(
+        "resnet50", "PascalVOC",
+        TEST__RPN_PRE_NMS_TOP_N=300, TEST__RPN_POST_NMS_TOP_N=32,
+    )
+    net = dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4))
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((96, 128),), MAX_GT=8)
+    return cfg.replace(network=net, tpu=tpu)
+
+
+def records_for(pred, cfg, img):
+    """Offline path on one image, self-padded to batch 2 (the serve
+    batch shape, so the engine-warmed programs are reused)."""
+    prepared, im_info = prepare_image(img, cfg, cfg.tpu.SCALES[0])
+    rois, valid, scores, deltas, _ = [
+        np.asarray(jax.device_get(x)) for x in pred.predict(
+            np.stack([prepared, prepared]), np.stack([im_info, im_info]))]
+    boxes = decode_image_boxes(rois[0], deltas[0], im_info)
+    return detections_to_records(per_class_nms(
+        scores[0], boxes, valid[0], cfg.NUM_CLASSES,
+        cfg.TEST.THRESH, cfg.TEST.NMS, cfg.TEST.MAX_PER_IMAGE))
+
+
+def assert_matched(src, dst, thresh, tag):
+    """Every confident det in ``src`` has a twin in ``dst``."""
+    for r in src:
+        if r["score"] < thresh + SCORE_MARGIN:
+            continue
+        twins = [s for s in dst
+                 if s["cls"] == r["cls"]
+                 and abs(s["score"] - r["score"]) < SCORE_ATOL
+                 and np.allclose(s["bbox"], r["bbox"], atol=BBOX_ATOL_PX)]
+        assert twins, (tag, r, dst)
+
+
+def test_bf16_parity_and_per_dtype_steady_state():
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = denormalize_for_save(
+        init_params(model, cfg, jax.random.PRNGKey(0), 2, (96, 128)), cfg)
+
+    pred32 = Predictor(model, params, cfg)
+    pred16 = Predictor(model, params, cfg, dtype="bfloat16")
+    assert pred32.registry.dtype == "float32"
+    assert pred16.registry.dtype == "bfloat16"
+
+    # bf16 behind a real engine: warmup readies one program per
+    # orientation, steady-state traffic must add zero — per dtype
+    engine = ServeEngine(pred16, cfg, ServeOptions(
+        batch_size=2, max_delay_ms=5.0, max_queue=16)).start()
+    try:
+        assert warmup(engine) == 2
+        rng = np.random.RandomState(7)
+        images = [rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+                  for h, w in ((60, 100), (100, 60))]
+        for img in images:
+            dets = engine.submit(img, deadline_ms=0).result(timeout=300.0)
+            assert isinstance(dets, list)
+        assert (engine.counters["recompiles"]
+                == engine.counters["warmup_programs"] == 2)
+        assert engine.counters["recompiles_bfloat16"] == 2
+        assert engine.metrics()["dtype"] == "bfloat16"
+        assert engine.metrics()["compile"]["dtype"] == "bfloat16"
+
+        # parity on the warmed shapes: confident detections must match
+        # 1:1 between the f32 and bf16 variants, both directions
+        for img in images:
+            r32 = records_for(pred32, cfg, img)
+            r16 = records_for(pred16, cfg, img)
+            assert_matched(r32, r16, cfg.TEST.THRESH, "f32->bf16")
+            assert_matched(r16, r32, cfg.TEST.THRESH, "bf16->f32")
+    finally:
+        engine.stop()
+
+    # the two dtypes were separate programs end to end
+    assert pred16.registry.snapshot()["programs"]
+    assert all(p["dtype"] == "bfloat16"
+               for p in pred16.registry.snapshot()["programs"])
+    assert all(p["dtype"] == "float32"
+               for p in pred32.registry.snapshot()["programs"])
+
+
+def test_int8_variant_runs_and_is_finite():
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = denormalize_for_save(
+        init_params(model, cfg, jax.random.PRNGKey(0), 2, (96, 128)), cfg)
+    pred = Predictor(model, params, cfg, dtype="int8")
+    assert pred.registry.dtype == "int8"
+
+    img = np.random.RandomState(3).randint(0, 255, (60, 100, 3),
+                                           dtype=np.uint8)
+    prepared, im_info = prepare_image(img, cfg, cfg.tpu.SCALES[0])
+    rois, valid, scores, deltas, _ = [
+        np.asarray(jax.device_get(x)) for x in pred.predict(
+            np.stack([prepared, prepared]), np.stack([im_info, im_info]))]
+    # weight quantization must not produce NaN/Inf anywhere downstream
+    for name, arr in (("rois", rois), ("scores", scores),
+                      ("deltas", deltas)):
+        assert np.isfinite(arr).all(), name
+    assert scores.dtype == np.float32  # outputs cast back to f32
+    assert rois.shape[-1] == 4 and valid.dtype == bool

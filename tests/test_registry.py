@@ -1,0 +1,169 @@
+"""Program-registry unit tests (host-side: no model, no XLA compile).
+
+Covers the marker-manifest protocol the AOT warm start rests on —
+first-dispatch accounting, cross-instance (simulating cross-process)
+hit/miss, the dtype/digest/sharding key axes, forged-marker collision
+handling — and the LRU bound on built callables.  The cross-PROCESS
+half of the story (a real second server boot loading executables from
+the persistent XLA cache) lives in tests/test_warmstart.py.
+"""
+
+import json
+import os
+
+import jax
+import pytest
+
+from mx_rcnn_tpu.compile import (ProgramKey, ProgramRegistry, config_digest,
+                                 registry_cache_dir)
+from mx_rcnn_tpu.compile.registry import CACHE_SCHEMA
+
+
+@pytest.fixture
+def jax_cache_guard():
+    """ProgramRegistry(cache_base=...) OWNS the process-global jax
+    compilation cache config — restore the suite's machine-dir cache
+    afterwards so later tests keep their warm compiles."""
+    from jax.experimental.compilation_cache import compilation_cache
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)
+        # configure_jax_cache reset the live cache instance; reset again
+        # so the suite re-initializes against its machine dir
+        compilation_cache.reset_cache()
+
+
+def test_note_dispatch_first_seen_once_and_markers(tmp_path,
+                                                   jax_cache_guard):
+    reg = ProgramRegistry(dtype="float32", cache_base=str(tmp_path))
+    assert reg.owns_cache and reg.cache_dir.startswith(str(tmp_path))
+
+    # first sighting: True (the "this dispatch compiles" signal), no
+    # marker on disk yet → aot_miss
+    assert reg.note_dispatch("predict", (2, 96, 128, 3)) is True
+    assert reg.note_dispatch("predict", (2, 96, 128, 3)) is False
+    assert reg.note_dispatch("predict", (2, 128, 96, 3)) is True
+    assert reg.counters == {"programs": 2, "aot_hit": 0, "aot_miss": 2,
+                            "key_collisions": 0, "evictions": 0}
+
+    # each first dispatch left a marker manifest entry
+    markers = os.listdir(os.path.join(reg.cache_dir, "programs"))
+    assert len(markers) == 2 and all(m.endswith(".json") for m in markers)
+    key = reg.key_for("predict", (2, 96, 128, 3))
+    with open(reg._marker_path(key)) as f:
+        assert json.load(f) == key.fields()
+
+    # a second registry over the SAME base (the "second process"):
+    # matching markers are AOT hits, a new shape is still a miss
+    reg2 = ProgramRegistry(dtype="float32", cache_base=str(tmp_path))
+    assert reg2.note_dispatch("predict", (2, 96, 128, 3)) is True
+    assert reg2.note_dispatch("predict", (2, 128, 96, 3)) is True
+    assert reg2.note_dispatch("predict", (4, 96, 128, 3)) is True
+    assert reg2.counters["aot_hit"] == 2
+    assert reg2.counters["aot_miss"] == 1
+    assert reg2.counters["key_collisions"] == 0
+
+
+def test_key_axes_separate_cache_namespaces(tmp_path, jax_cache_guard):
+    # dtype is folded into the FINGERPRINT DIR, not just the key: a bf16
+    # replica and an f32 replica over one base never share entries
+    d_f32 = registry_cache_dir(str(tmp_path), "float32")
+    d_bf16 = registry_cache_dir(str(tmp_path), "bfloat16")
+    assert d_f32 != d_bf16
+
+    reg = ProgramRegistry(dtype="float32", cache_base=str(tmp_path))
+    reg.note_dispatch("predict", (2, 96, 128, 3))
+    reg_b = ProgramRegistry(dtype="bfloat16", cache_base=str(tmp_path))
+    assert reg_b.note_dispatch("predict", (2, 96, 128, 3)) is True
+    assert reg_b.counters["aot_miss"] == 1  # disjoint dir: no hit
+
+    # kind / shape / digest each change the key hash within one dir
+    k = reg.key_for("predict", (2, 96, 128, 3))
+    assert reg.key_for("predict_rpn", (2, 96, 128, 3)).hash() != k.hash()
+    assert reg.key_for("predict", (4, 96, 128, 3)).hash() != k.hash()
+    other = ProgramKey("deadbeefdeadbeef", k.kind, k.shape, k.batch,
+                       k.dtype, k.sharding)
+    assert other.hash() != k.hash()
+    assert k.fields()["schema"] == CACHE_SCHEMA
+
+
+def test_forged_marker_counts_collision_and_is_overwritten(tmp_path,
+                                                           jax_cache_guard):
+    reg = ProgramRegistry(dtype="float32", cache_base=str(tmp_path))
+    key = reg.key_for("predict", (2, 96, 128, 3))
+    path = reg._marker_path(key)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    forged = dict(key.fields(), digest="0000000000000000")
+    with open(path, "w") as f:
+        json.dump(forged, f)
+
+    # same hash path, different fields: a collision — counted, treated
+    # as a miss (never trusted), and overwritten with the true fields
+    assert reg.note_dispatch("predict", (2, 96, 128, 3)) is True
+    assert reg.counters["key_collisions"] == 1
+    assert reg.counters["aot_miss"] == 1 and reg.counters["aot_hit"] == 0
+    with open(path) as f:
+        assert json.load(f) == key.fields()
+
+    # unreadable marker is also a collision, not a crash
+    key2 = reg.key_for("predict_rpn", (2, 96, 128, 3))
+    path2 = reg._marker_path(key2)
+    with open(path2, "w") as f:
+        f.write("{not json")
+    assert reg.note_dispatch("predict_rpn", (2, 96, 128, 3)) is True
+    assert reg.counters["key_collisions"] == 2
+
+
+def test_lookup_lru_eviction_and_rebuild():
+    # no cache_base: piggyback mode, global jax config untouched
+    reg = ProgramRegistry(max_programs=2)
+    calls = []
+
+    def builder(*static):
+        calls.append(static)
+        return lambda: static
+
+    reg.register("fn", builder)
+    a = reg.lookup("fn", ("a",))
+    b = reg.lookup("fn", ("b",))
+    assert reg.lookup("fn", ("a",)) is a  # cached, LRU-refreshed
+    assert calls == [("a",), ("b",)]
+
+    c = reg.lookup("fn", ("c",))  # evicts LRU entry ("b")
+    assert reg.counters["evictions"] == 1
+    assert reg.lookup("fn", ("a",)) is a and reg.lookup("fn", ("c",)) is c
+    assert calls == [("a",), ("b",), ("c",)]
+
+    assert reg.lookup("fn", ("b",)) is not b  # evicted: rebuilt
+    assert calls == [("a",), ("b",), ("c",), ("b",)]
+    assert reg.counters["evictions"] == 2
+
+    with pytest.raises(KeyError):
+        reg.lookup("nope")
+
+
+def test_snapshot_shape_and_digest_stability():
+    from mx_rcnn_tpu.config import generate_config
+
+    cfg = generate_config("resnet50", "PascalVOC")
+    assert config_digest(cfg) == config_digest(cfg)
+    assert config_digest(cfg) != config_digest(
+        generate_config("resnet50", "PascalVOC", TEST__NMS=0.11))
+    assert config_digest(None) == "none"
+
+    reg = ProgramRegistry(cfg, dtype="bfloat16")
+    reg.note_dispatch("predict", (2, 96, 128, 3))
+    reg.record_compile_seconds("predict", (2, 96, 128, 3), 0.25)
+    snap = reg.snapshot()
+    assert snap["dtype"] == "bfloat16"
+    assert snap["digest"] == config_digest(cfg)
+    assert snap["counters"]["programs"] == 1
+    (prog,) = snap["programs"]
+    assert prog["kind"] == "predict" and prog["compile_s"] == 0.25
+    assert snap["compile_seconds"]["count"] == 1
