@@ -232,6 +232,22 @@ class Predictor:
                          if sh is not None else jax.device_put(batch["images"]))
         return out
 
+    def update_params(self, params) -> None:
+        """Swap the bound weights in place — the serving hot-reload
+        primitive.  Applies the same variant cast + device placement as
+        construction; because every registered program takes ``params``
+        as a RUNTIME argument (see :meth:`_dispatch`), the registry's
+        compiled executables are reused as-is: a weight swap costs zero
+        recompiles.  The caller (serve drain) must ensure no forward is
+        in flight — ``self.params`` is rebound atomically but a batch
+        straddling the swap would mix generations."""
+        params = _variant_params(params, self.infer_dtype)
+        if self.plan is not None:
+            params = jax.device_put(params, self.plan.replicated())
+        self.params = params
+        self._feats = None  # cached pyramid belongs to the old weights
+        self._feats_token = None
+
     def note_dispatch(self, shape) -> bool:
         """Registry first-seen accounting for the program ``predict`` will
         dispatch on ``shape`` — True exactly once per shape per process

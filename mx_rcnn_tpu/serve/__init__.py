@@ -8,26 +8,45 @@ jitted bucket programs, and the shared ``ops/postprocess`` block that
 * ``engine``     — async queue + bucket-aware dynamic batcher (deadline
   flush, partial-batch padding, bounded-queue backpressure).
 * ``frontend``   — stdlib HTTP endpoints (``/predict``, ``/healthz``,
-  ``/metrics``) over TCP or a Unix socket, plus a stdio mode.
+  ``/readyz``, ``/metrics``) over TCP or a Unix socket, plus stdio.
 * ``warmup``     — eager compilation of every (bucket, batch) program so
-  the first request never pays XLA compile.
+  the first request never pays XLA compile; completion = readiness.
 * ``controller`` — SLO-driven admission control: adapts per-bucket flush
   batch/delay toward ``--target-p99-ms`` off the engine's own latency
   histograms and sheds load when the queue trend predicts misses.
+* ``replica``    — the replica-side of the multi-replica plane: child
+  main loop, zero-downtime checkpoint hot-reload with canary rollback,
+  checkpoint watching, and the ``MXR_FAULT_REPLICA_*`` chaos injectors.
+* ``supervisor`` — the parent-side: liveness/readiness probing, crash/
+  hang detection, backoff respawn with a systemic limit, rolling
+  reloads, and the retry-budgeted request router.
 
-Driver: top-level ``serve.py``; load generator: ``scripts/loadgen.py``;
-throughput: ``bench.py --mode serve``; smoke: ``script/serve_smoke.sh``
-and ``script/slo_smoke.sh``.
+Driver: top-level ``serve.py`` (``--replicas N`` for the plane);
+load generator: ``scripts/loadgen.py``; throughput: ``bench.py --mode
+serve``; smoke: ``script/serve_smoke.sh``, ``script/slo_smoke.sh``, and
+``script/replica_smoke.sh``.
 """
 
 from mx_rcnn_tpu.serve.controller import ControllerOptions, SLOController
 from mx_rcnn_tpu.serve.engine import (DeadlineExceededError, RejectedError,
                                       ServeEngine, ServeFuture, ServeOptions)
 from mx_rcnn_tpu.serve.frontend import (encode_image_payload, make_server,
-                                        run_stdio, unix_http_request)
+                                        run_stdio, unix_http_request,
+                                        unix_http_request_raw)
+from mx_rcnn_tpu.serve.replica import (CheckpointWatcher, ReplicaFaults,
+                                       make_reloader, reload_engine_params,
+                                       scan_checkpoints, serve_replica)
+from mx_rcnn_tpu.serve.supervisor import (ReplicaRouter, ReplicaSpec,
+                                          ReplicaSupervisor,
+                                          SupervisorOptions,
+                                          make_router_server, replica_specs)
 from mx_rcnn_tpu.serve.warmup import warmup
 
 __all__ = ["ServeEngine", "ServeOptions", "ServeFuture", "RejectedError",
            "DeadlineExceededError", "SLOController", "ControllerOptions",
            "make_server", "run_stdio", "unix_http_request",
-           "encode_image_payload", "warmup"]
+           "unix_http_request_raw", "encode_image_payload", "warmup",
+           "CheckpointWatcher", "ReplicaFaults", "make_reloader",
+           "reload_engine_params", "scan_checkpoints", "serve_replica",
+           "ReplicaRouter", "ReplicaSpec", "ReplicaSupervisor",
+           "SupervisorOptions", "make_router_server", "replica_specs"]

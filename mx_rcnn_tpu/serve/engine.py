@@ -181,6 +181,18 @@ class ServeEngine:
         self._cond = threading.Condition(self._lock)
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        # readiness (distinct from liveness): set by warmup() once every
+        # (bucket, batch) program is registered — /readyz gates routing on
+        # it while /healthz only proves the process answers
+        self._ready = threading.Event()
+        # drain mode (weight hot-reload): no NEW admissions, queued work
+        # still flushes; _inflight counts batches handed to the predictor
+        # so drain() can block until the device is quiescent
+        self._draining = False
+        self._inflight = 0
+        # checkpoint generation serving right now (atomic under _lock;
+        # bumped by the hot-reload path, exposed on /metrics and /readyz)
+        self.generation = 0
         # program bookkeeping: a real Predictor carries a ProgramRegistry
         # (one key space for trainer/eval/serve, AOT hit/miss accounting
         # against the persistent cache); duck-typed predictors fall back
@@ -244,6 +256,56 @@ class ServeEngine:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+
+    # -- readiness / drain (replica supervision + hot reload) ------------
+
+    def mark_ready(self):
+        """Warmup's signal: every steady-state program is registered.
+        Flips ``/readyz`` to 200 (once per process unless a drain is in
+        progress)."""
+        self._ready.set()
+
+    def is_ready(self) -> bool:
+        with self._lock:
+            return (self._ready.is_set() and not self._draining
+                    and not self._stop and self._thread is not None)
+
+    def readiness(self) -> dict:
+        """The ``/readyz`` payload — warmup + admission state, distinct
+        from ``/healthz`` liveness (a warming or draining replica is alive
+        but must not receive routed traffic)."""
+        with self._lock:
+            return {
+                "ready": (self._ready.is_set() and not self._draining
+                          and not self._stop and self._thread is not None),
+                "warmed": self._ready.is_set(),
+                "draining": self._draining,
+                "generation": self.generation,
+                "queue_depth": sum(len(q) for q in self._queues.values()),
+                "admit_limit": self._admit_limit,
+            }
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting (503) and block until every queued request has
+        flushed and no batch is on the device — the quiescent point a
+        weight swap needs.  Returns False if the queue didn't empty within
+        ``timeout`` (caller should resume() and retry later)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while (any(self._queues.values()) or self._inflight):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(remaining, 0.05))
+        return True
+
+    def resume(self):
+        """Re-open admissions after a drain()."""
+        with self._cond:
+            self._draining = False
+            self._cond.notify_all()
 
     # -- intake ----------------------------------------------------------
 
@@ -364,6 +426,14 @@ class ServeEngine:
                 self.counters["rejected"] += 1
                 tel.counter("serve/rejected")
                 raise RejectedError("engine stopped")
+            if self._draining:
+                # weight swap in progress: queued work still flushes but
+                # nothing new is admitted — the router retries on an
+                # alternate replica, a bare client backs off briefly
+                self.counters["rejected"] += 1
+                tel.counter("serve/rejected")
+                raise RejectedError(
+                    "draining (weight swap in progress) — retry shortly")
             depth = sum(len(q) for q in self._queues.values())
             if self._admit_limit is not None and depth >= self._admit_limit:
                 # controller-driven early shed: the queue is NOT full, but
@@ -448,6 +518,8 @@ class ServeEngine:
                 now = time.monotonic()
                 expired = self._sweep_expired_locked(now)
                 batch, wait = self._next_batch_locked(now)
+                if batch is not None:
+                    self._inflight += 1
                 if batch is None and not expired:
                     self._cond.wait(timeout=wait)
                     continue
@@ -465,6 +537,10 @@ class ServeEngine:
                     logger.exception("serve batch failed")
                     for r in batch:
                         r.future._set_error(e)
+                finally:
+                    with self._cond:
+                        self._inflight -= 1
+                        self._cond.notify_all()  # drain() waits on this
 
     def _run_batch(self, reqs: List[_Request], now: float):
         import jax
@@ -568,6 +644,10 @@ class ServeEngine:
                             "max_queue": self.opts.max_queue,
                             "deadline_ms": self.opts.deadline_ms},
                 "admit_limit": self._admit_limit,
+                "generation": self.generation,
+                "ready": (self._ready.is_set() and not self._draining
+                          and not self._stop and self._thread is not None),
+                "draining": self._draining,
             }
         latency = {}
         for name, h in self.hists.items():

@@ -18,7 +18,16 @@ Endpoints:
   ``"deadline_ms"``.  200 → ``{"detections": [{"cls", "score", "bbox"}...],
   "queue_wait_ms"}``; 503 queue full (backpressure — retry with backoff);
   504 deadline exceeded; 400 malformed.
-* ``GET /healthz`` — 200 once the engine thread is up.
+* ``GET /healthz`` — liveness: 200 once the engine thread is up (a
+  warming or draining replica still answers — backward-compatible).
+* ``GET /readyz`` — readiness: 200 only once warmup has registered every
+  program AND admissions are open (not draining for a weight swap);
+  503 otherwise.  What the replica supervisor and smoke scripts gate
+  routing on — liveness and readiness are deliberately distinct.
+* ``POST /admin/reload`` — replica-local checkpoint hot-swap (only when
+  the server was built with a ``reloader`` callback; 404 otherwise).
+  Body is a reload target doc; 200 → new generation live, 409 → load or
+  canary failure, previous weights restored.
 * ``GET /metrics`` — engine counters + queue state as JSON; with
   ``Accept: text/plain`` or ``?format=prom``, Prometheus text exposition
   instead — rendered by ``telemetry/obs.py`` from the same registry the
@@ -111,6 +120,9 @@ def handle_request_doc(engine: ServeEngine, doc: dict) -> tuple:
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     engine: ServeEngine = None  # set by make_server subclassing
+    reloader = None      # optional callback(doc) -> (status, doc)
+    request_hook = None  # optional callback(status) after each /predict
+    gate = None          # optional callback() before any handling
 
     # -- plumbing --------------------------------------------------------
 
@@ -136,10 +148,15 @@ class _Handler(BaseHTTPRequestHandler):
     # -- endpoints -------------------------------------------------------
 
     def do_GET(self):
+        if self.gate is not None:
+            self.gate()
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             self._reply(200, {"status": "ok",
                               "queue_depth": self.engine.queue_depth()})
+        elif path == "/readyz":
+            doc = self.engine.readiness()
+            self._reply(200 if doc["ready"] else 503, doc)
         elif path == "/metrics":
             # content negotiation: JSON stays the default for existing
             # callers; Prometheus scrapers ask via Accept or ?format=prom
@@ -154,16 +171,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
-        if self.path != "/predict":
+        if self.gate is not None:
+            self.gate()
+        if self.path not in ("/predict", "/admin/reload"):
             self._reply(404, {"error": f"no route {self.path}"})
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
-            doc = json.loads(self.rfile.read(length))
+            doc = json.loads(self.rfile.read(length) or b"{}")
         except (ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": f"bad JSON body: {e}"})
             return
-        self._reply(*handle_request_doc(self.engine, doc))
+        if self.path == "/admin/reload":
+            if self.reloader is None:
+                self._reply(404, {"error": "no reloader configured"})
+                return
+            self._reply(*self.reloader(doc))
+            return
+        status, resp = handle_request_doc(self.engine, doc)
+        self._reply(status, resp)
+        if self.request_hook is not None:
+            self.request_hook(status)
 
 
 class _TCPHTTPServer(ThreadingHTTPServer):
@@ -188,9 +216,15 @@ class _UnixHTTPServer(_TCPHTTPServer):
 
 def make_server(engine: ServeEngine, port: Optional[int] = None,
                 host: str = "127.0.0.1",
-                unix_socket: Optional[str] = None):
+                unix_socket: Optional[str] = None,
+                reloader=None, request_hook=None, gate=None):
     """Build (not start) the HTTP server — exactly one of ``port`` /
-    ``unix_socket``.  Caller owns ``serve_forever``/``shutdown``."""
+    ``unix_socket``.  Caller owns ``serve_forever``/``shutdown``.
+
+    ``reloader`` enables ``POST /admin/reload`` (the replica hot-swap
+    endpoint); ``request_hook(status)`` fires after each ``/predict``
+    reply and ``gate()`` before any handling — the chaos harness's
+    kill-after-N / hang injection points."""
     if (port is None) == (unix_socket is None):
         raise ValueError("pass exactly one of port / unix_socket")
 
@@ -198,19 +232,26 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
         pass
 
     Handler.engine = engine
+    # staticmethod: a plain function stored on the class would otherwise
+    # bind as a method and receive the handler as a bogus first argument
+    Handler.reloader = staticmethod(reloader) if reloader else None
+    Handler.request_hook = (staticmethod(request_hook)
+                            if request_hook else None)
+    Handler.gate = staticmethod(gate) if gate else None
     if unix_socket is not None:
         return _UnixHTTPServer(unix_socket, Handler)
     return _TCPHTTPServer((host, port), Handler)
 
 
-def unix_http_request(sock_path: str, method: str, path: str,
-                      doc: Optional[dict] = None,
-                      timeout: float = 60.0,
-                      headers: Optional[dict] = None) -> tuple:
-    """Minimal HTTP client over a Unix socket → (status, response_doc).
-    The test/loadgen counterpart of ``make_server(unix_socket=...)``.
-    JSON responses come back parsed; anything else (the Prometheus text
-    negotiated via ``headers={"Accept": "text/plain"}``) as str."""
+def unix_http_request_raw(sock_path: str, method: str, path: str,
+                          body: Optional[bytes] = None,
+                          timeout: float = 60.0,
+                          headers: Optional[dict] = None) -> tuple:
+    """Byte-level HTTP over a Unix socket → (status, body_bytes, ctype).
+    The router's forwarding primitive: request bodies pass through
+    verbatim (no decode→re-encode of base64 image payloads on the
+    hot path).  Raises ``OSError`` family on transport failure — a dead
+    or hung replica — which is the retry-on-alternate trigger."""
     import http.client
 
     class Conn(http.client.HTTPConnection):
@@ -224,18 +265,32 @@ def unix_http_request(sock_path: str, method: str, path: str,
 
     conn = Conn()
     try:
-        body = json.dumps(doc).encode() if doc is not None else None
         hdrs = dict(headers or {})
         if body:
             hdrs.setdefault("Content-Type", "application/json")
         conn.request(method, path, body=body, headers=hdrs)
         resp = conn.getresponse()
-        raw = resp.read()
-        if "json" in (resp.getheader("Content-Type") or ""):
-            return resp.status, json.loads(raw)
-        return resp.status, raw.decode()
+        return (resp.status, resp.read(),
+                resp.getheader("Content-Type") or "")
     finally:
         conn.close()
+
+
+def unix_http_request(sock_path: str, method: str, path: str,
+                      doc: Optional[dict] = None,
+                      timeout: float = 60.0,
+                      headers: Optional[dict] = None) -> tuple:
+    """Minimal HTTP client over a Unix socket → (status, response_doc).
+    The test/loadgen counterpart of ``make_server(unix_socket=...)``.
+    JSON responses come back parsed; anything else (the Prometheus text
+    negotiated via ``headers={"Accept": "text/plain"}``) as str."""
+    body = json.dumps(doc).encode() if doc is not None else None
+    status, raw, ctype = unix_http_request_raw(
+        sock_path, method, path, body=body, timeout=timeout,
+        headers=headers)
+    if "json" in ctype:
+        return status, json.loads(raw)
+    return status, raw.decode()
 
 
 def run_stdio(engine: ServeEngine, inp=None, out=None):

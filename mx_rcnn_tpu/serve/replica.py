@@ -1,0 +1,437 @@
+"""Replica-side half of the multi-replica serving plane (ISSUE 8).
+
+One replica = one supervised subprocess (``serve.py --replica-index I``)
+pinned to a device or device group, running the ordinary
+Predictor → ServeEngine → HTTP stack over a Unix socket the router
+forwards to.  This module owns everything that happens INSIDE the
+replica process:
+
+* :func:`serve_replica` — the child's main loop: HTTP up FIRST (so
+  liveness probes answer during a slow warmup), then warmup → ready,
+  then park until SIGTERM.
+* :func:`reload_engine_params` — the zero-downtime weight swap:
+  drain → load → :meth:`Predictor.update_params` → canary probe →
+  re-ready, with rollback to the previous weights when the new
+  generation produces non-finite outputs on a golden image.  Because
+  params are a RUNTIME argument to every registered program (PR-7
+  registry), the swap reuses all compiled executables — zero
+  steady-state recompiles, asserted by tests and the smoke script.
+* :func:`scan_checkpoints` / :class:`CheckpointWatcher` — filesystem
+  polling of the PR-2 checkpoint layout (``{prefix}/{epoch}`` +
+  ``{prefix}/steps/{key}``), feeding reload targets to whoever rolls
+  them (the supervisor across replicas, or the in-process path at
+  ``--replicas 1``).
+* :class:`ReplicaFaults` — the serve-side chaos harness: behavior is
+  driven by ``MXR_FAULT_REPLICA_*`` env vars (the resilience.py
+  ``MXR_FAULT_*`` precedent) so tests and ``script/replica_smoke.sh``
+  inject kill -9 / hang / slow-start / corrupt-checkpoint without
+  touching the code path under test.
+
+Fault-injection env contract (each var is a comma-separated list of
+``INDEX[:VALUE]`` tokens; a token applies to the replica whose
+``--replica-index`` matches):
+
+* ``MXR_FAULT_REPLICA_KILL_AFTER="0:5"``   — SIGKILL self (kill -9
+  semantics) after 5 served 2xx requests.
+* ``MXR_FAULT_REPLICA_HANG_AFTER="1:3"``   — wedge every subsequent
+  HTTP handler (including probes) after 3 served requests: the
+  crash-undetectable-by-waitpid case the supervisor's probe-timeout
+  hang detection exists for.
+* ``MXR_FAULT_REPLICA_SLOW_START_S="0:8"`` — sleep 8s between liveness
+  and readiness (alive-but-warming), exercising the /healthz vs
+  /readyz split.
+* ``MXR_FAULT_REPLICA_CORRUPT_CKPT="0"``   — poison every float leaf of
+  the next reloaded checkpoint with NaN, forcing the canary probe to
+  reject the generation and roll back.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.data.loader import prepare_image
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.serve.frontend import make_server
+from mx_rcnn_tpu.serve.warmup import warmup
+from mx_rcnn_tpu.train.resilience import decode_step_key
+
+ENV_KILL_AFTER = "MXR_FAULT_REPLICA_KILL_AFTER"
+ENV_HANG_AFTER = "MXR_FAULT_REPLICA_HANG_AFTER"
+ENV_SLOW_START = "MXR_FAULT_REPLICA_SLOW_START_S"
+ENV_CORRUPT_CKPT = "MXR_FAULT_REPLICA_CORRUPT_CKPT"
+# set by the supervisor on each child; the injectors match against it
+ENV_REPLICA_INDEX = "MXR_REPLICA_INDEX"
+# optional device pinning: the supervisor splits --replica-devices into
+# per-child groups under this var; deployment images map it onto their
+# platform's visibility env (TPU_VISIBLE_CHIPS / CUDA_VISIBLE_DEVICES)
+ENV_REPLICA_DEVICES = "MXR_REPLICA_DEVICES"
+
+# how long a drain may take before the reload aborts (the queue keeps
+# flushing during drain, so this only trips on a wedged dispatcher)
+RELOAD_DRAIN_TIMEOUT_S = 60.0
+
+
+def _fault_value(env_name: str, index: int,
+                 env=os.environ) -> Optional[str]:
+    """The VALUE of the ``INDEX[:VALUE]`` token matching ``index`` in
+    ``env_name`` ("" for a bare-INDEX token), or None."""
+    for tok in env.get(env_name, "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        idx, _, value = tok.partition(":")
+        try:
+            if int(idx) == index:
+                return value
+        except ValueError:
+            logger.warning("bad %s token %r (want INDEX[:VALUE])",
+                           env_name, tok)
+    return None
+
+
+class ReplicaFaults:
+    """Parsed ``MXR_FAULT_REPLICA_*`` state for one replica index, wired
+    into the frontend's ``request_hook``/``gate`` and the reload path.
+    With no matching env tokens every method is a cheap no-op."""
+
+    def __init__(self, index: int, env=os.environ):
+        self.index = index
+
+        def _num(name, cast):
+            v = _fault_value(name, index, env)
+            return None if v in (None, "") else cast(v)
+
+        self.kill_after = _num(ENV_KILL_AFTER, int)
+        self.hang_after = _num(ENV_HANG_AFTER, int)
+        self.slow_start_s = _num(ENV_SLOW_START, float) or 0.0
+        self.corrupt_ckpt = _fault_value(ENV_CORRUPT_CKPT, index,
+                                         env) is not None
+        self._served = 0
+        self._hung = False
+        self._lock = threading.Lock()
+
+    def request_hook(self, status: int):
+        """After each /predict reply: count 2xx and fire kill/hang once
+        the configured count is reached."""
+        with self._lock:
+            if 200 <= status < 300:
+                self._served += 1
+            served = self._served
+        if self.kill_after is not None and served >= self.kill_after:
+            logger.warning("FAULT replica %d: SIGKILL self after %d "
+                           "served requests", self.index, served)
+            os.kill(os.getpid(), signal.SIGKILL)
+        if self.hang_after is not None and served >= self.hang_after:
+            self._hung = True
+
+    def gate(self):
+        """Before any HTTP handling: a hung replica wedges every handler
+        thread — probes included — which is exactly what the supervisor's
+        probe-timeout detection must catch (waitpid never fires)."""
+        if self._hung:
+            logger.warning("FAULT replica %d: hanging handler thread",
+                           self.index)
+            time.sleep(3600.0)
+
+    def slow_start(self):
+        if self.slow_start_s > 0:
+            logger.warning("FAULT replica %d: slow start %.1fs (alive, "
+                           "not ready)", self.index, self.slow_start_s)
+            time.sleep(self.slow_start_s)
+
+
+def poison_params(params):
+    """The corrupt-checkpoint injection: NaN every float leaf (dict
+    pytrees and bare numbers), leaving structure intact so the swap
+    itself succeeds and only the CANARY catches it — the realistic
+    bad-weights failure (half-written file, diverged training run)."""
+    if isinstance(params, dict):
+        return {k: poison_params(v) for k, v in params.items()}
+    arr = np.asarray(params)
+    if np.issubdtype(arr.dtype, np.floating):
+        return np.full_like(arr, np.nan)
+    return params
+
+
+# -- checkpoint discovery (PR-2 layout, no orbax import) -------------------
+
+def scan_checkpoints(prefix: str) -> Optional[dict]:
+    """Newest committed checkpoint under ``prefix`` as a reload target
+    ``{"prefix", "kind", "epoch", "consumed"}`` — epoch dirs
+    ``{prefix}/{E}`` and step dirs ``{prefix}/steps/{E*1e7+C}``, the
+    furthest position winning exactly like ``latest_resume_point`` (a
+    finished epoch beats its own mid-epoch saves).  Pure listdir — orbax
+    commits by atomic rename, so an int-named dir is a committed save
+    and in-progress ``*.orbax-checkpoint-tmp*`` names never int-parse."""
+    if not os.path.isdir(prefix):
+        return None
+    cands = []
+    for name in os.listdir(prefix):
+        try:
+            e = int(name)
+        except ValueError:
+            continue
+        if os.path.isdir(os.path.join(prefix, name)):
+            cands.append((e, 0, "epoch"))
+    steps_dir = os.path.join(prefix, "steps")
+    if os.path.isdir(steps_dir):
+        for name in os.listdir(steps_dir):
+            try:
+                key = int(name)
+            except ValueError:
+                continue
+            if os.path.isdir(os.path.join(steps_dir, name)):
+                e, c = decode_step_key(key)
+                cands.append((e, c, "step"))
+    if not cands:
+        return None
+    e, c, kind = max(cands)
+    return {"prefix": prefix, "kind": kind, "epoch": e, "consumed": c}
+
+
+def target_key(target: dict) -> tuple:
+    """Identity of a reload target for dedup/bad-list bookkeeping."""
+    return (target["epoch"], target["consumed"], target["kind"])
+
+
+def load_serving_params(target: dict, cfg):
+    """Load a reload target's params DENORMALIZED for inference: epoch
+    checkpoints via ``load_epoch(for_training=False)``; step checkpoints
+    hold the RAW training parametrization, so the live-training-tracking
+    path must apply ``denormalize_for_save`` itself or served boxes
+    would decode against folded bbox stats."""
+    from mx_rcnn_tpu.train.checkpoint import (CheckpointManager,
+                                              denormalize_for_save)
+
+    mgr = CheckpointManager(target["prefix"])
+    if target["kind"] == "step":
+        payload = mgr.load_step_checkpoint(target["epoch"],
+                                           target["consumed"])
+        return denormalize_for_save(payload["params"], cfg)
+    params, _, _ = mgr.load_epoch(target["epoch"], cfg, for_training=False)
+    return params
+
+
+class CheckpointWatcher:
+    """Polls a checkpoint prefix and fires ``reload_fn(target)`` when a
+    NEWER generation appears.  Failed targets (load error, canary
+    rejection) go on a bad list and are never retried — a corrupt save
+    must not flap the plane; the next good save supersedes it.
+    ``poll_once`` is the injectable-clock-style test surface; ``start``
+    wraps it in a daemon thread for production."""
+
+    def __init__(self, prefix: str, reload_fn: Callable[[dict], bool],
+                 interval_s: float = 5.0, scan_fn=None):
+        self.prefix = prefix
+        self.reload_fn = reload_fn
+        self.interval_s = interval_s
+        self._scan = scan_fn or scan_checkpoints
+        self._last: Optional[tuple] = None
+        self._bad: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def prime(self):
+        """Mark whatever is on disk NOW as already-served (the weights
+        the replicas booted from) so the first poll doesn't redundantly
+        reload the boot checkpoint onto itself."""
+        tgt = self._scan(self.prefix)
+        if tgt is not None:
+            self._last = target_key(tgt)
+        return tgt
+
+    def poll_once(self):
+        """One scan→maybe-reload step.  Returns None when nothing new,
+        else ``(target, ok)``."""
+        tgt = self._scan(self.prefix)
+        if tgt is None:
+            return None
+        key = target_key(tgt)
+        if key == self._last or key in self._bad:
+            return None
+        if self._last is not None and key < self._last:
+            return None  # never roll BACKWARD off a stale dir listing
+        logger.info("checkpoint watcher: new generation %s under %s",
+                    key, self.prefix)
+        ok = bool(self.reload_fn(tgt))
+        if ok:
+            self._last = key
+        else:
+            self._bad.add(key)
+            telemetry.get().counter("replica/reload_bad_target")
+            logger.warning("checkpoint watcher: target %s rejected — "
+                           "skipping it until a newer save appears", key)
+        return tgt, ok
+
+    def start(self) -> "CheckpointWatcher":
+        assert self._thread is None, "watcher already started"
+        self.prime()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — keep watching
+                    logger.exception("checkpoint watcher poll failed")
+
+        self._thread = threading.Thread(target=loop, name="ckpt-watcher",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# -- the hot swap ----------------------------------------------------------
+
+def golden_image(h: int, w: int) -> np.ndarray:
+    """Deterministic canary input: a horizontal gradient (not zeros —
+    constant inputs can hide scale-dependent blowups)."""
+    row = np.linspace(32, 224, w).astype(np.uint8)
+    return np.ascontiguousarray(
+        np.broadcast_to(row[None, :, None], (h, w, 3)))
+
+
+def canary_probe(engine, predictor) -> tuple:
+    """Forward a golden batch at the WARMED landscape bucket shape and
+    check every float output is finite — the cheap, recompile-free
+    weights-sanity gate a new generation must pass before it serves.
+    Returns (ok, reason)."""
+    short, long_ = engine._scale
+    prepared, im_info = prepare_image(golden_image(short, long_),
+                                      engine.cfg, engine._scale)
+    B = engine.opts.batch_size
+    images = np.stack([prepared] * B)
+    infos = np.stack([im_info] * B)
+    out = predictor.predict(images, infos)
+    names = ("rois", "roi_valid", "cls_prob", "bbox_deltas")
+    for name, arr in zip(names, out[:len(names)]):
+        arr = np.asarray(arr)
+        if (np.issubdtype(arr.dtype, np.floating)
+                and not np.isfinite(arr).all()):
+            return False, f"non-finite {name} on golden image"
+    return True, "ok"
+
+
+def reload_engine_params(engine, predictor, cfg, target: dict,
+                         load_params_fn=None, faults=None) -> tuple:
+    """The zero-downtime swap on one engine: drain → load → swap →
+    canary → resume.  Returns ``(ok, info)``; on any failure the
+    previous weights are restored verbatim (the exact pre-swap leaves,
+    so rollback itself is also recompile-free) and the engine resumes
+    serving them.  ``info["recompiles_during_swap"]`` pins the PR-7
+    registry-reuse contract: 0 in steady state."""
+    tel = telemetry.get()
+    t0 = time.monotonic()
+    gen = int(target.get("generation", engine.generation + 1))
+    if not engine.drain(timeout=RELOAD_DRAIN_TIMEOUT_S):
+        engine.resume()
+        return False, {"error": "drain timed out — dispatcher wedged?",
+                       "rolled_back": False}
+    old = getattr(predictor, "params", None)
+    recompiles_before = engine.counters["recompiles"]
+    try:
+        load = load_params_fn or load_serving_params
+        params = load(target, cfg)
+        if faults is not None and faults.corrupt_ckpt:
+            logger.warning("FAULT: poisoning reloaded checkpoint %s with "
+                           "NaN", target_key(target))
+            params = poison_params(params)
+        predictor.update_params(params)
+        ok, reason = canary_probe(engine, predictor)
+        if not ok:
+            predictor.params = old  # rollback: pre-swap leaves, no cast
+            tel.counter("serve/reload_rollback")
+            tel.dump_flight("reload_canary_failed", generation=gen,
+                            target=list(target_key(target)), cause=reason)
+            logger.error("hot reload of %s REJECTED (%s) — rolled back "
+                         "to generation %d", target_key(target), reason,
+                         engine.generation)
+            return False, {"error": f"canary failed: {reason}",
+                           "rolled_back": True}
+    except Exception as e:  # noqa: BLE001 — a bad save must not kill serving
+        if old is not None:
+            predictor.params = old
+        tel.counter("serve/reload_rollback")
+        logger.exception("hot reload of %s failed — rolled back",
+                         target_key(target))
+        return False, {"error": f"{type(e).__name__}: {e}",
+                       "rolled_back": True}
+    finally:
+        engine.resume()
+    with engine._lock:
+        engine.generation = max(engine.generation, gen)
+    swap_recompiles = engine.counters["recompiles"] - recompiles_before
+    tel.counter("serve/reload")
+    tel.gauge("serve/generation", engine.generation)
+    wall = time.monotonic() - t0
+    logger.info("hot reload: generation %d live from %s in %.2fs "
+                "(%d recompile(s) during swap)", engine.generation,
+                target_key(target), wall, swap_recompiles)
+    return True, {"generation": engine.generation,
+                  "target": list(target_key(target)),
+                  "wall_s": round(wall, 3),
+                  "recompiles_during_swap": swap_recompiles}
+
+
+def make_reloader(engine, predictor, cfg, load_params_fn=None,
+                  faults=None):
+    """The frontend's ``POST /admin/reload`` callback: body is a reload
+    target doc, 200 → new generation live, 409 → rejected + rolled
+    back.  Serialized — concurrent reloads of one replica make no
+    sense and would race the drain."""
+    lock = threading.Lock()
+
+    def reloader(doc: dict) -> tuple:
+        required = {"prefix", "kind", "epoch", "consumed"}
+        if not required.issubset(doc):
+            return 400, {"error": f"reload target needs {sorted(required)}"}
+        with lock:
+            ok, info = reload_engine_params(
+                engine, predictor, cfg, doc,
+                load_params_fn=load_params_fn, faults=faults)
+        return (200 if ok else 409), info
+
+    return reloader
+
+
+# -- the child main loop ---------------------------------------------------
+
+def serve_replica(engine, cfg, sock_path: str, index: int = 0,
+                  predictor=None, load_params_fn=None,
+                  done: Optional[threading.Event] = None) -> None:
+    """Run one replica to completion: HTTP server FIRST (liveness probes
+    must answer while warmup compiles), then warmup → ready, then park
+    until ``done`` (set by the driver's signal handler) — finally stop
+    the server and fail whatever is still queued.  The engine must be
+    ``start()``ed; ``predictor`` defaults to ``engine.predictor``."""
+    predictor = predictor if predictor is not None else engine.predictor
+    faults = ReplicaFaults(index)
+    reloader = make_reloader(engine, predictor, cfg,
+                             load_params_fn=load_params_fn, faults=faults)
+    server = make_server(engine, unix_socket=sock_path, reloader=reloader,
+                         request_hook=faults.request_hook,
+                         gate=faults.gate)
+    th = threading.Thread(target=server.serve_forever,
+                          name=f"replica-{index}-http", daemon=True)
+    th.start()
+    logger.info("replica %d: live on %s (warming)", index, sock_path)
+    faults.slow_start()
+    warmup(engine)  # sets engine readiness → /readyz flips to 200
+    logger.info("replica %d: ready (generation %d)", index,
+                engine.generation)
+    done = done or threading.Event()
+    done.wait()
+    server.shutdown()
+    engine.stop()

@@ -58,6 +58,9 @@ def warmup(engine) -> int:
     dt = time.perf_counter() - t0
     compiled = engine.counters["recompiles"] - before
     engine.counters["warmup_programs"] += compiled
+    # warmup completion IS readiness: /readyz flips to 200 here, so a
+    # supervisor never routes traffic into a replica still compiling
+    engine.mark_ready()
     tel = telemetry.get()
     tel.counter("serve/warmup_programs", compiled)
     tel.gauge("serve/warmup_compile_s", dt)

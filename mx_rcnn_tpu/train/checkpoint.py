@@ -149,7 +149,12 @@ class CheckpointManager:
             restored = self._mgr.restore(
                 epoch, args=ocp.args.StandardRestore(abstract_payload))
         else:
-            restored = self._mgr.restore(epoch)
+            # target-less StandardRestore, never a bare restore(): a
+            # manager that didn't write the save (fresh process — eval,
+            # serving hot-reload) has no handler registered for the
+            # item and bare restore() raises KeyError
+            restored = self._mgr.restore(epoch,
+                                         args=ocp.args.StandardRestore())
         params = restored["params"]
         if for_training:
             params = normalize_for_train(params, cfg)
@@ -217,7 +222,9 @@ class CheckpointManager:
         if abstract_payload is not None:
             return mgr.restore(
                 key, args=ocp.args.StandardRestore(abstract_payload))
-        return mgr.restore(key)
+        # see load_epoch: target-less StandardRestore for fresh-process
+        # readers (bare restore() requires the writer's handler registry)
+        return mgr.restore(key, args=ocp.args.StandardRestore())
 
     def latest_resume_point(self) -> Optional[Tuple[str, int, int]]:
         """The furthest position any checkpoint reaches, for auto-resume:

@@ -1,0 +1,244 @@
+#!/usr/bin/env bash
+# Multi-replica serving-plane smoke (CPU-friendly): three acts over the
+# real model with synthetic weights, sharing one AOT program cache so
+# only the first boot compiles.
+#
+#   1. Baseline — the classic single-replica server, measured with
+#      scripts/loadgen.py for the per-replica imgs/sec reference.
+#   2. Chaos — a 2-replica plane where replica 0 SIGKILLs itself
+#      mid-burst (MXR_FAULT_REPLICA_KILL_AFTER): every client response
+#      must be 200/503 only (transport errors are absorbed by the
+#      router's retry-on-alternate), the availability floor must hold,
+#      the supervisor must respawn the corpse back to ready=2, and the
+#      parent must leave a replica_down flight dump.
+#   3. Hot reload — a fresh 2-replica plane with --watch-checkpoints; a
+#      REAL CheckpointManager epoch save lands mid-traffic and rolls
+#      through both replicas with ZERO non-2xx responses
+#      (loadgen --assert-2xx is the zero-dropped-requests gate),
+#      generation 1 everywhere, no rollback.  The same plane then takes
+#      a burst for the aggregate throughput number.
+#
+# The baseline/aggregate pair + chaos availability become an
+# mxr_replica_report (REPLICA_r01.json) scored by scripts/perf_gate.py
+# as absolute-floor rows.
+#
+#   bash script/replica_smoke.sh
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${REPLICA_SMOKE_DIR:-/tmp/mxr_replica_smoke}
+rm -rf "$dir"
+mkdir -p "$dir"
+cache="$dir/program_cache"   # shared AOT warm-start: 3 boots, 1 compile
+
+common=(--network resnet50 --synthetic --serve-batch 2 --max-delay-ms 20
+        --max-queue 32 --deadline-ms 120000 --program-cache "$cache"
+        --cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)"
+        --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32)
+
+# wait_ready SOCK PID WANT: poll until the server is ready — /readyz for
+# the single server (WANT=1), the router's /metrics supervisor.ready
+# count for a plane (warmup + compile gate readiness, so this can take a
+# while on a cold cache)
+wait_ready() {
+python - "$1" "$2" "$3" <<'EOF'
+import os, sys, time
+from mx_rcnn_tpu.serve import unix_http_request
+sock, pid, want = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("serve.py exited before becoming ready")
+    try:
+        if want <= 1:
+            status, _ = unix_http_request(sock, "GET", "/readyz", timeout=5)
+            if status == 200:
+                sys.exit(0)
+        else:
+            status, m = unix_http_request(sock, "GET", "/metrics", timeout=5)
+            if status == 200 and m["supervisor"]["ready"] >= want:
+                sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1)
+sys.exit("serve.py never became ready")
+EOF
+}
+
+# ---- act 1: single-replica baseline --------------------------------------
+echo "replica_smoke: [1/3] single-replica baseline"
+sock1="$dir/single.sock"
+python serve.py "${common[@]}" --unix-socket "$sock1" &
+pid=$!
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_ready "$sock1" "$pid" 1
+python scripts/loadgen.py --unix-socket "$sock1" --n 24 --rate 100 \
+  --short 80 --long 110 --assert-2xx | tee "$dir/baseline.json"
+kill -TERM "$pid"
+wait "$pid"
+trap - EXIT
+
+# ---- act 2: chaos — kill -9 one of two replicas mid-burst ----------------
+echo "replica_smoke: [2/3] chaos: replica 0 SIGKILLs itself mid-burst"
+sockc="$dir/chaos.sock"
+telc="$dir/tel_chaos"
+# replica 0 (and every respawn of it) SIGKILLs itself after serving 6
+# requests; rate 2 ≈ what this CPU actually serves, so the queue (and
+# the dead-until-probed retry window) stays well inside the retry
+# budget and the deadline
+MXR_FAULT_REPLICA_KILL_AFTER="0:6" python serve.py "${common[@]}" \
+  --replicas 2 --unix-socket "$sockc" --telemetry-dir "$telc" &
+pid=$!
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_ready "$sockc" "$pid" 2
+python scripts/loadgen.py --unix-socket "$sockc" --n 30 --rate 2 \
+  --short 80 --long 110 | tee "$dir/chaos.json"
+
+# error budget held during the crash, then the plane healed itself
+python - "$dir/chaos.json" "$sockc" "$telc" <<'EOF'
+import json, os, sys, time
+from mx_rcnn_tpu.serve import unix_http_request
+doc = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+bad = set(doc["status"]) - {"200", "503"}
+assert not bad, f"chaos burst leaked statuses {sorted(bad)}: {doc['status']}"
+assert doc["status"].get("200", 0) >= 24, doc["status"]
+assert doc["availability"] >= 0.9, doc
+sock, tel = sys.argv[2], sys.argv[3]
+deadline = time.time() + 180
+while True:  # recovery: the corpse respawned and came back ready
+    status, m = unix_http_request(sock, "GET", "/metrics", timeout=10)
+    assert status == 200, m
+    sup = m["supervisor"]
+    if sup["counters"]["respawn"] >= 1 and sup["ready"] == 2:
+        break
+    if time.time() > deadline:
+        sys.exit(f"plane never recovered: {sup}")
+    time.sleep(1)
+c = sup["counters"]
+assert c["transport_error"] + c["retry_ok"] >= 1, \
+    f"the kill was never observed on the wire: {c}"
+flight = os.path.join(tel, "flight_0.jsonl")
+assert os.path.exists(flight), f"no flight dump at {flight}"
+assert "replica_down" in open(flight).read(), flight
+print(f"replica_smoke: chaos OK (status={doc['status']}, "
+      f"availability={doc['availability']}, respawns={c['respawn']}, "
+      f"retries={c['retry_ok']}, ttr_s={doc.get('time_to_recover_s')})")
+EOF
+
+# post-recovery probe: the healed plane serves clean (4 requests split
+# round-robin stay under the respawned replica's next kill_after=6 fuse)
+python scripts/loadgen.py --unix-socket "$sockc" --n 4 --rate 10 \
+  --short 80 --long 110 --assert-2xx >/dev/null
+kill -TERM "$pid"
+wait "$pid"
+trap - EXIT
+
+# ---- act 3: rolling hot-reload under traffic -----------------------------
+echo "replica_smoke: [3/3] zero-downtime rolling reload"
+sockr="$dir/reload.sock"
+telr="$dir/tel_reload"
+ckpt="$dir/ckpt"
+stage="$dir/stage"
+mkdir -p "$ckpt"
+python serve.py "${common[@]}" --replicas 2 --unix-socket "$sockr" \
+  --telemetry-dir "$telr" --watch-checkpoints "$ckpt" \
+  --watch-interval-s 1 &
+pid=$!
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+
+# build a REAL PR-2 epoch save (denormalize-at-save path) into a staging
+# dir while the plane warms up; it is renamed into the watched prefix
+# mid-traffic below, exactly how a training run commits a checkpoint
+python - "$stage" <<'EOF'
+import dataclasses, sys
+import jax
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models import build_model, init_params
+from mx_rcnn_tpu.train.checkpoint import CheckpointManager
+cfg = generate_config("resnet50", "PascalVOC",
+                      TEST__RPN_PRE_NMS_TOP_N=300,
+                      TEST__RPN_POST_NMS_TOP_N=32)
+cfg = cfg.replace(
+    network=dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4)),
+    tpu=dataclasses.replace(cfg.tpu, SCALES=((96, 128),)))
+model = build_model(cfg)
+params = init_params(model, cfg, jax.random.PRNGKey(1), batch_size=1)
+CheckpointManager(sys.argv[1]).save_epoch(1, params, cfg)
+print("replica_smoke: epoch-1 checkpoint staged")
+EOF
+
+wait_ready "$sockr" "$pid" 2
+
+# steady traffic spanning the whole roll; --assert-2xx IS the
+# zero-dropped-requests gate (a draining replica's 503 must be retried
+# onto its peer, never surfaced)
+python scripts/loadgen.py --unix-socket "$sockr" --n 50 --rate 2 \
+  --short 80 --long 110 --assert-2xx >"$dir/reload_traffic.json" &
+lg=$!
+sleep 2
+mv "$stage/1" "$ckpt/1"   # atomic rename = orbax's own commit protocol
+wait "$lg"                # any non-2xx during the swap fails the smoke
+
+# generation 1 live on every replica, one reload per replica, no rollback
+python - "$sockr" <<'EOF'
+import sys, time
+from mx_rcnn_tpu.serve import unix_http_request
+sock = sys.argv[1]
+deadline = time.time() + 120
+while True:
+    status, m = unix_http_request(sock, "GET", "/metrics", timeout=10)
+    assert status == 200, m
+    sup = m["supervisor"]
+    gens = [r["generation"] for r in sup["replicas"].values()]
+    if (sup["generation"] == 1 and len(gens) == 2
+            and all(g == 1 for g in gens) and sup["ready"] == 2):
+        break
+    if time.time() > deadline:
+        sys.exit(f"generation 1 never fully rolled: {sup}")
+    time.sleep(1)
+c = sup["counters"]
+assert c["reload"] == 2, c
+assert c["reload_rollback"] == 0, c
+print(f"replica_smoke: reload OK (generation={sup['generation']}, "
+      f"reloads={c['reload']}, rollbacks={c['reload_rollback']})")
+EOF
+
+# aggregate throughput of the (freshly reloaded) 2-replica plane, same
+# burst shape as the baseline
+python scripts/loadgen.py --unix-socket "$sockr" --n 24 --rate 100 \
+  --short 80 --long 110 --assert-2xx | tee "$dir/aggregate.json"
+kill -TERM "$pid"
+wait "$pid"
+trap - EXIT
+
+# ---- report + perf gate --------------------------------------------------
+python - "$dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+def last_json(p):
+    return json.loads(open(p).read().strip().splitlines()[-1])
+base = last_json(f"{d}/baseline.json")
+agg = last_json(f"{d}/aggregate.json")
+chaos = last_json(f"{d}/chaos.json")
+doc = {
+    "schema": "mxr_replica_report", "version": 1,
+    "replicas": 2,
+    "per_replica_imgs_per_sec": base["imgs_per_sec"],
+    "aggregate_imgs_per_sec": agg["imgs_per_sec"],
+    # CPU smoke: both replicas contend for the same host cores, so
+    # near-linear scaling is impossible here — override the 0.85
+    # default floor the one-device-group-per-replica TPU gate uses
+    "linearity_floor": 0.35,
+    "availability": chaos["availability"],
+    "availability_floor": 0.9,
+    "time_to_recover_s": chaos.get("time_to_recover_s"),
+}
+with open(f"{d}/REPLICA_r01.json", "w") as f:
+    json.dump(doc, f, indent=1, sort_keys=True)
+lin = doc["aggregate_imgs_per_sec"] / (2 * doc["per_replica_imgs_per_sec"])
+print(f"replica_smoke: report OK (linearity={lin:.2f}, "
+      f"availability={doc['availability']})")
+EOF
+python scripts/perf_gate.py --check-format "$dir"/REPLICA_r*.json
+python scripts/perf_gate.py --dir "$dir"
+echo "replica_smoke: OK"

@@ -43,7 +43,14 @@ machine-readable SLO report ``scripts/perf_gate.py`` gates:
   {"schema": "mxr_slo_report", "version": 1,
    "scenarios": [{"name": "steady", "requests": ..., "status": {...},
                   "p50_ms": ..., "p99_ms": ..., "error_rate": ...,
+                  "availability": ..., "time_to_recover_s": ...,
                   "imgs_per_sec": ..., "wall_s": ...}, ...]}
+
+Failover metrics (ISSUE 8): ``availability`` is the 2xx fraction over
+NON-SHED submits (503s are deliberate backpressure, not unavailability);
+``time_to_recover_s`` is the gap from the first hard failure (5xx or
+transport error) to the next 2xx COMPLETION after it, null when the run
+never hard-failed.
 
 latency percentiles are over 2xx responses (client-observed, including
 queue wait + forward + post-process + transport); ``imgs_per_sec`` is
@@ -162,7 +169,9 @@ def tcp_request(host, port, doc, timeout):
 def run_requests(args, docs, offsets):
     """Fire every payload at its offset (open loop); returns
     ``(results, wall_s)`` where results[i] is
-    ``(status, latency_s, queue_wait_ms, error_str)``."""
+    ``(status, latency_s, queue_wait_ms, error_str, t_done_s)`` —
+    ``t_done_s`` is the completion instant relative to the run start,
+    what the time-to-recover failover metric is computed from."""
     n = len(docs)
     results = [None] * n
 
@@ -178,10 +187,12 @@ def run_requests(args, docs, offsets):
                                            args.timeout)
         except Exception as e:  # noqa: BLE001 — a dead server is a result
             results[i] = (0, time.perf_counter() - t0, None,
-                          f"{type(e).__name__}: {e}")
+                          f"{type(e).__name__}: {e}",
+                          time.perf_counter() - t_start)
             return
         results[i] = (status, time.perf_counter() - t0,
-                      resp.get("queue_wait_ms"), None)
+                      resp.get("queue_wait_ms"), None,
+                      time.perf_counter() - t_start)
 
     t_start = time.perf_counter()
     threads = []
@@ -200,17 +211,33 @@ def run_requests(args, docs, offsets):
 def summarize(results, wall):
     n = len(results)
     status_counts = {}
-    for st, _, _, _ in results:
-        status_counts[str(st)] = status_counts.get(str(st), 0) + 1
+    for r in results:
+        status_counts[str(r[0])] = status_counts.get(str(r[0]), 0) + 1
     ok = [r for r in results if 200 <= r[0] < 300]
     lat_ms = np.asarray([r[1] for r in ok]) * 1e3
     qw = [r[2] for r in ok if r[2] is not None]
+    # availability: 2xx over NON-SHED submits — 503s are deliberate
+    # backpressure/degradation (the shed contract), not unavailability;
+    # 5xx and transport errors (status 0) are
+    non_shed = n - status_counts.get("503", 0)
+    # time-to-recover: first hard failure (5xx/transport, NOT the shed
+    # 503s — same exclusion as availability) → the next 2xx COMPLETION
+    # after it; null when the run never hard-failed (or never
+    # recovered) — the failover metric replica chaos runs gate on
+    fail_ts = sorted(r[4] for r in results
+                     if r[0] == 0 or (r[0] >= 500 and r[0] != 503))
+    recover_s = None
+    if fail_ts:
+        after = [r[4] for r in ok if r[4] > fail_ts[0]]
+        recover_s = round(min(after) - fail_ts[0], 3) if after else None
     out = {
         "requests": n,
         "status": dict(sorted(status_counts.items())),
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 3) if ok else None,
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 3) if ok else None,
         "error_rate": round((n - len(ok)) / max(n, 1), 4),
+        "availability": round(len(ok) / max(non_shed, 1), 4),
+        "time_to_recover_s": recover_s,
         "mean_queue_wait_ms": (round(float(np.mean(qw)), 3) if qw else None),
         "imgs_per_sec": round(len(ok) / wall, 3) if wall > 0 else None,
         "wall_s": round(wall, 3),
@@ -225,9 +252,9 @@ def assert_2xx_failure(results):
     """None when every response was 2xx, else the stderr line naming each
     offending status code and its count (0 = transport error)."""
     bad = {}
-    for st, _, _, _ in results:
-        if not 200 <= st < 300:
-            bad[st] = bad.get(st, 0) + 1
+    for r in results:
+        if not 200 <= r[0] < 300:
+            bad[r[0]] = bad.get(r[0], 0) + 1
     if not bad:
         return None
     total = sum(bad.values())
@@ -264,7 +291,8 @@ def main(argv=None):
             report_rows.append({"name": scenario or "default", **{
                 k: v for k, v in out.items()
                 if k in ("requests", "status", "p50_ms", "p99_ms",
-                         "error_rate", "imgs_per_sec", "wall_s")}})
+                         "error_rate", "availability", "time_to_recover_s",
+                         "imgs_per_sec", "wall_s")}})
         print(json.dumps(out))
 
     if args.report:

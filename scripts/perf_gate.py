@@ -54,6 +54,11 @@ ERROR_RATE_ABS_SLACK = 0.02
 # with whatever else CI runs, and sub-second jitter on a warm-cache
 # boot must not read as a lost AOT warm start
 STARTUP_ABS_SLACK_S = 2.0
+# multi-replica linearity floor: aggregate imgs/sec must reach at least
+# this fraction of per-replica × N on the CPU smoke — below it the
+# router/supervisor overhead (or accidental serialization) is eating
+# the replication win
+REPLICA_LINEARITY_FLOOR = 0.85
 
 
 def slo_report_rows(doc: dict) -> list:
@@ -79,6 +84,34 @@ def slo_report_rows(doc: dict) -> list:
     return rows
 
 
+def replica_report_rows(doc: dict) -> list:
+    """Expand an ``mxr_replica_report`` (script/replica_smoke.sh) into
+    FLOOR rows: scored against an absolute minimum on the newest run
+    alone — replication linearity is a property, not a trend, so a
+    single run can (and must) fail on its own."""
+    rows = []
+    n = doc.get("replicas")
+    agg = doc.get("aggregate_imgs_per_sec")
+    per = doc.get("per_replica_imgs_per_sec")
+    if (isinstance(n, int) and n > 0
+            and isinstance(agg, (int, float))
+            and isinstance(per, (int, float)) and per > 0):
+        rows.append({"metric": "replica_linearity",
+                     "value": round(agg / (per * n), 4),
+                     "unit": "fraction",
+                     "floor": doc.get("linearity_floor",
+                                      REPLICA_LINEARITY_FLOOR)})
+    avail = doc.get("availability")
+    if isinstance(avail, (int, float)):
+        floor = doc.get("availability_floor")
+        row = {"metric": "replica_availability", "value": avail,
+               "unit": "fraction"}
+        if isinstance(floor, (int, float)):
+            row["floor"] = floor
+        rows.append(row)
+    return rows
+
+
 def load_rows(path: str) -> list:
     """Extract metric rows from one trajectory artifact.  Shapes seen in
     the wild: the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
@@ -90,6 +123,8 @@ def load_rows(path: str) -> list:
         doc = json.load(f)
     if isinstance(doc, dict) and doc.get("schema") == "mxr_slo_report":
         return slo_report_rows(doc)
+    if isinstance(doc, dict) and doc.get("schema") == "mxr_replica_report":
+        return replica_report_rows(doc)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return startup_rows([doc["parsed"]])
     if isinstance(doc, dict) and "metric" in doc:
@@ -147,7 +182,8 @@ def build_series(paths: list) -> dict:
     series: dict = {}
     for path in paths:
         for row in load_rows(path):
-            if "vs_baseline" not in row and row.get("direction") != "down":
+            if ("vs_baseline" not in row and row.get("direction") != "down"
+                    and "floor" not in row):
                 continue  # BENCH_BASELINE.json: not a trajectory point
             key = (row.get("metric", "?"), row.get("baseline_method"))
             series.setdefault(key, []).append((path, row))
@@ -160,6 +196,19 @@ def gate(series: dict, threshold: float = GATE_THRESHOLD) -> list:
     failures = []
     for (metric, method), hist in sorted(
             series.items(), key=lambda kv: (kv[0][0], kv[0][1] or "")):
+        if any("floor" in r for _, r in hist):
+            # absolute floor (replica linearity/availability): the newest
+            # run is scored alone — no prior trajectory needed, a single
+            # sub-floor run fails
+            newest_path, newest_row = hist[-1]
+            v, floor = newest_row.get("value"), newest_row.get("floor")
+            if (isinstance(v, (int, float))
+                    and isinstance(floor, (int, float)) and v < floor):
+                failures.append(
+                    f"{metric}: value {v:g} "
+                    f"({os.path.basename(newest_path)}) is below the "
+                    f"required floor {floor:g}")
+            continue
         if any(r.get("direction") == "down" for _, r in hist):
             # lower-is-better: score the raw value against the best
             # (lowest) prior, with any per-row absolute slack added
@@ -210,7 +259,9 @@ def trend_table(series: dict) -> str:
             note = ""
             if row.get("baseline_recorded"):
                 note = "  (baseline recorded this run — not scored)"
-            if row.get("direction") == "down":
+            if "floor" in row:
+                score = f"floor={row['floor']:g}"
+            elif row.get("direction") == "down":
                 score = "direction=down"
             else:
                 score = f"vs_baseline={'null' if vs is None else f'{vs:g}'}"
@@ -225,10 +276,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("paths", nargs="*",
                     help="trajectory files (default: --dir/BENCH_r*.json "
-                         "+ --dir/SLO_r*.json)")
+                         "+ --dir/SLO_r*.json + --dir/REPLICA_r*.json)")
     ap.add_argument("--dir", default=".",
-                    help="where to glob BENCH_r*.json / SLO_r*.json when "
-                         "no paths given")
+                    help="where to glob BENCH_r*.json / SLO_r*.json / "
+                         "REPLICA_r*.json when no paths given")
     ap.add_argument("--threshold", type=float, default=GATE_THRESHOLD,
                     help="allowed fractional drop vs the best prior run "
                          "(default 0.10)")
@@ -240,7 +291,8 @@ def main(argv=None) -> int:
 
     paths = args.paths or (
         sorted(glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
-        + sorted(glob.glob(os.path.join(args.dir, "SLO_r*.json"))))
+        + sorted(glob.glob(os.path.join(args.dir, "SLO_r*.json")))
+        + sorted(glob.glob(os.path.join(args.dir, "REPLICA_r*.json"))))
     if not paths:
         print("perf_gate: no BENCH_*.json / SLO_*.json files found",
               file=sys.stderr)

@@ -14,24 +14,33 @@ serve ``/predict`` with bucket-aware dynamic batching until SIGTERM/SIGINT.
     python serve.py --network resnet101 --prefix model/e2e --epoch 10 \
         --port 8321 --serve-batch 8 --max-delay-ms 10 --telemetry-dir /tmp/t
 
-Scale-out contract: one replica per host/chip set behind a load balancer
-(the Predictor is single-controller by design — see its multiprocess
-error); ``--max-queue`` bounds each replica's admission so overload
-sheds as fast 503s the balancer can retry elsewhere, not as queue bloat.
+    # self-healing plane: 2 supervised replicas behind a router, rolling
+    # checkpoint hot-reload as training writes new saves
+    python serve.py --network resnet101 --prefix model/e2e --epoch 10 \
+        --port 8321 --replicas 2 --watch-checkpoints model/e2e
+
+Scale-out contract (``--replicas N``): the parent builds NO model — it
+runs the ReplicaSupervisor + ReplicaRouter (serve/supervisor.py) over N
+child processes of this same script (``--replica-index I``, internal),
+each a full Predictor→engine→HTTP stack on its own Unix socket.  Replica
+failure is a 503-shed + retry-on-alternate + backoff respawn; SIGTERM
+drains gracefully and a SECOND SIGTERM hard-aborts (flight dump +
+SIGKILL the children) so a wedged drain can never hang shutdown.  At
+``--replicas 1`` (default) behavior is unchanged from before the plane
+existed.
 """
 
 from __future__ import annotations
 
 import argparse
+import atexit
+import os
 import signal
+import tempfile
 import threading
 
 from mx_rcnn_tpu import telemetry
-from mx_rcnn_tpu.eval import Predictor
 from mx_rcnn_tpu.logger import logger
-from mx_rcnn_tpu.models import build_model
-from mx_rcnn_tpu.serve import (ControllerOptions, ServeEngine, ServeOptions,
-                               SLOController, make_server, warmup)
 from mx_rcnn_tpu.tools.common import (add_common_args, apply_program_cache,
                                       config_from_args,
                                       eval_params_from_args,
@@ -80,16 +89,88 @@ def parse_args():
                         dest="slo_window_s",
                         help="trailing window the controller's p99 is "
                              "computed over")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="run N supervised engine replicas behind a "
+                             "router (1 = the classic single-process "
+                             "server, unchanged)")
+    parser.add_argument("--replica-index", type=int, default=-1,
+                        dest="replica_index",
+                        help=argparse.SUPPRESS)  # internal: child mode
+    parser.add_argument("--replica-devices", default="",
+                        dest="replica_devices",
+                        help="semicolon-separated device groups, one per "
+                             "replica (group i lands in child env "
+                             "MXR_REPLICA_DEVICES for the deployment "
+                             "image to map onto TPU_VISIBLE_CHIPS / "
+                             "CUDA_VISIBLE_DEVICES)")
+    parser.add_argument("--watch-checkpoints", default="",
+                        dest="watch_checkpoints",
+                        help="poll this checkpoint prefix (PR-2 layout: "
+                             "epoch dirs + steps/) and hot-reload new "
+                             "generations with zero downtime — rolling "
+                             "across replicas, canary-gated, rollback on "
+                             "non-finite outputs")
+    parser.add_argument("--watch-interval-s", type=float, default=5.0,
+                        dest="watch_interval_s",
+                        help="checkpoint watcher poll period")
     return parser.parse_args()
 
 
-def main(args):
-    if not args.unix_socket and not args.port:
-        raise SystemExit("pass --port or --unix-socket")
-    cfg = config_from_args(args, train=False)
+def _install_signals(done: threading.Event, hard_cleanup=None):
+    """First SIGTERM/SIGINT = graceful drain (flight-record + set
+    ``done``); the SECOND = hard abort — flight dump, SIGKILL any child
+    replicas, ``os._exit`` — so a wedged drain can't hang shutdown."""
+    state = {"armed": False}
+
+    def _on_signal(signum, frame):
+        name = signal.Signals(signum).name
+        if state["armed"]:
+            telemetry.get().dump_flight("hard_abort", signal=name)
+            logger.error("second %s: hard abort", name)
+            if hard_cleanup is not None:
+                try:
+                    hard_cleanup()
+                except Exception:  # noqa: BLE001 — exiting anyway
+                    pass
+            os._exit(130)
+        state["armed"] = True
+        telemetry.get().dump_flight("preempt_signal", signal=name)
+        done.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _on_signal)
+
+
+def _build_engine(args, cfg):
+    """checkpoint → Predictor → started ServeEngine (single + replica
+    paths share this; the supervisor parent never builds one)."""
+    from mx_rcnn_tpu.eval import Predictor
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.serve import ServeEngine, ServeOptions
+
     apply_program_cache(args)  # before the Predictor builds its registry
     model = build_model(cfg)
     params = eval_params_from_args(args, cfg, model)
+    predictor = Predictor(model, params, cfg, dtype=args.infer_dtype)
+    engine = ServeEngine(predictor, cfg, ServeOptions(
+        batch_size=args.serve_batch, max_delay_ms=args.max_delay_ms,
+        max_queue=args.max_queue, deadline_ms=args.deadline_ms,
+        # the common --loader-workers flag doubles as the serving prep
+        # pool size (same data/workers.py pool, image-only tasks)
+        prep_workers=args.loader_workers or 0)).start()
+    return predictor, engine
+
+
+def main_single(args):
+    """The classic single-process server (--replicas 1), plus optional
+    in-process checkpoint hot-reload when --watch-checkpoints is set."""
+    from mx_rcnn_tpu.serve import (CheckpointWatcher, ControllerOptions,
+                                   SLOController, make_server,
+                                   reload_engine_params, warmup)
+
+    if not args.unix_socket and not args.port:
+        raise SystemExit("pass --port or --unix-socket")
+    cfg = config_from_args(args, train=False)
     # the plane owns the sink (configure → summary → shutdown) and, with
     # --obs-port, the live Prometheus endpoint; the frontend's own
     # /metrics keeps serving regardless (JSON + ?format=prom)
@@ -98,13 +179,7 @@ def main(args):
                                         "serve_batch": args.serve_batch,
                                         "max_delay_ms": args.max_delay_ms},
                               configure_telemetry=True)
-    predictor = Predictor(model, params, cfg, dtype=args.infer_dtype)
-    engine = ServeEngine(predictor, cfg, ServeOptions(
-        batch_size=args.serve_batch, max_delay_ms=args.max_delay_ms,
-        max_queue=args.max_queue, deadline_ms=args.deadline_ms,
-        # the common --loader-workers flag doubles as the serving prep
-        # pool size (same data/workers.py pool, image-only tasks)
-        prep_workers=args.loader_workers or 0)).start()
+    predictor, engine = _build_engine(args, cfg)
     warmup(engine)
     controller = None
     if args.target_p99_ms > 0:
@@ -113,22 +188,25 @@ def main(args):
             interval_s=args.slo_interval_ms / 1e3,
             window_s=args.slo_window_s)).start()
 
+    watcher = None
+    if args.watch_checkpoints:
+        def _reload(target):
+            ok, info = reload_engine_params(
+                engine, predictor, cfg,
+                dict(target, generation=engine.generation + 1))
+            return ok
+
+        watcher = CheckpointWatcher(args.watch_checkpoints, _reload,
+                                    interval_s=args.watch_interval_s)
+        watcher.start()
+
     server = make_server(engine, port=args.port or None, host=args.host,
                          unix_socket=args.unix_socket or None)
     # serve_forever on a worker thread; the main thread parks on an event
     # the signal handlers set — shutdown() called from the serving thread
     # itself would deadlock its poll loop
     done = threading.Event()
-
-    def _on_signal(signum, frame):
-        # flight-record the shutdown before draining — the ring holds the
-        # last serve/* events if anything hangs past this point
-        telemetry.get().dump_flight(
-            "preempt_signal", signal=signal.Signals(signum).name)
-        done.set()
-
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, _on_signal)
+    _install_signals(done)
     t = threading.Thread(target=server.serve_forever, name="serve-http",
                          daemon=True)
     t.start()
@@ -139,10 +217,108 @@ def main(args):
     done.wait()
     logger.info("shutting down: %s", engine.metrics()["counters"])
     server.shutdown()
+    if watcher is not None:
+        watcher.stop()
     if controller is not None:
         controller.stop()
     engine.stop()
     obs.close(extra={"serve": engine.metrics()})
+
+
+def main_replica(args):
+    """One supervised replica child (--replica-index I, internal): the
+    full engine stack over the supervisor-assigned Unix socket, folding
+    its telemetry as rank I+1 of a (replicas+1)-world so the parent's
+    obs plane aggregates per-replica snapshots (the PR-5 mechanism)."""
+    from mx_rcnn_tpu.serve import serve_replica
+
+    assert args.unix_socket, "--replica-index requires --unix-socket"
+    cfg = config_from_args(args, train=False)
+    obs = start_observability(args, "serve",
+                              rank=args.replica_index + 1,
+                              world=max(args.replicas, 1) + 1,
+                              run_meta={"network": args.network,
+                                        "replica": args.replica_index},
+                              configure_telemetry=True)
+    predictor, engine = _build_engine(args, cfg)
+    done = threading.Event()
+    _install_signals(done)
+    try:
+        serve_replica(engine, cfg, args.unix_socket,
+                      index=args.replica_index, predictor=predictor,
+                      done=done)
+    finally:
+        obs.close(extra={"serve": engine.metrics()})
+
+
+def main_plane(args):
+    """The supervisor parent (--replicas N > 1): no model, no device —
+    spawn N replica children, route /predict across the ready ones,
+    respawn the dead, roll checkpoint generations through them."""
+    import sys
+
+    from mx_rcnn_tpu.serve import (CheckpointWatcher, ReplicaRouter,
+                                   ReplicaSupervisor, make_router_server,
+                                   replica_specs)
+
+    if not args.unix_socket and not args.port:
+        raise SystemExit("pass --port or --unix-socket")
+    obs = start_observability(args, "serve", rank=0,
+                              world=args.replicas + 1,
+                              run_meta={"network": args.network,
+                                        "replicas": args.replicas},
+                              configure_telemetry=True)
+    sock_dir = tempfile.mkdtemp(prefix="mxr_replicas_")
+    specs = replica_specs(sys.argv, args.replicas, sock_dir,
+                          devices=args.replica_devices)
+    sup = ReplicaSupervisor(specs)
+    # no orphans: children die with the parent on EVERY exit path —
+    # normal drain, exception, or the hard-abort signal escalation
+    atexit.register(sup.sweep)
+    done = threading.Event()
+    _install_signals(done, hard_cleanup=lambda: sup.sweep(0.0))
+    sup.start()
+    router = ReplicaRouter(sup)
+    server = make_router_server(router, port=args.port or None,
+                                host=args.host,
+                                unix_socket=args.unix_socket or None)
+    watcher = None
+    if args.watch_checkpoints:
+        watcher = CheckpointWatcher(args.watch_checkpoints, sup.reload_to,
+                                    interval_s=args.watch_interval_s)
+        watcher.start()
+    t = threading.Thread(target=server.serve_forever, name="router-http",
+                         daemon=True)
+    t.start()
+    where = args.unix_socket or f"http://{args.host}:{args.port}"
+    logger.info("serving plane: %d replica(s) behind %s (sockets under "
+                "%s)", args.replicas, where, sock_dir)
+    # park until a signal OR systemic failure (every replica FAILED)
+    while not done.is_set():
+        if sup.broken.wait(timeout=0.5):
+            break
+        if done.wait(timeout=0.5):
+            break
+    broken = sup.broken.is_set() and not done.is_set()
+    logger.info("plane shutting down: %s", sup.metrics()["counters"])
+    server.shutdown()
+    if watcher is not None:
+        watcher.stop()
+    sup.stop()
+    obs.close(extra={"replica_plane": sup.metrics()})
+    if broken:
+        raise SystemExit("serving plane is down: every replica crossed "
+                         "the respawn limit (see flight dumps)")
+
+
+def main(args):
+    if args.replica_index >= 0:
+        # the child check comes FIRST: children keep --replicas for the
+        # obs world size, and must never recurse into main_plane
+        return main_replica(args)
+    if args.replicas > 1:
+        return main_plane(args)
+    return main_single(args)
 
 
 if __name__ == "__main__":

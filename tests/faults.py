@@ -17,7 +17,14 @@ Injectors:
   (exercises checkpoint I/O retry).
 * :func:`hang_until` — a producer generator that yields its items then
   blocks until released (exercises the prefetch-queue watchdog).
-"""
+
+Serve-side chaos (ISSUE 8): the injectors themselves live in
+``mx_rcnn_tpu/serve/replica.py`` (``MXR_FAULT_REPLICA_*``, parsed by
+``ReplicaFaults`` — package code, same placement rule as the
+``MXR_FAULT_*`` train injectors above); this module only provides
+:func:`replica_fault_env`, the composer tests and
+``script/replica_smoke.sh`` use to build the env dict for a chosen
+replica index, so the var names have exactly one spelling."""
 
 from __future__ import annotations
 
@@ -108,6 +115,27 @@ def flaky_saves(n: int, exc=OSError):
         yield calls
     finally:
         ocp.CheckpointManager.save = orig
+
+
+def replica_fault_env(index: int, kill_after=None, hang_after=None,
+                      slow_start_s=None, corrupt_ckpt=False) -> dict:
+    """Compose the ``MXR_FAULT_REPLICA_*`` env dict injecting the chosen
+    faults into replica ``index`` (merge into the child's env, or the
+    parent's — tokens are index-matched, so siblings are untouched)."""
+    from mx_rcnn_tpu.serve.replica import (ENV_CORRUPT_CKPT,
+                                           ENV_HANG_AFTER, ENV_KILL_AFTER,
+                                           ENV_SLOW_START)
+
+    env = {}
+    if kill_after is not None:
+        env[ENV_KILL_AFTER] = f"{index}:{int(kill_after)}"
+    if hang_after is not None:
+        env[ENV_HANG_AFTER] = f"{index}:{int(hang_after)}"
+    if slow_start_s is not None:
+        env[ENV_SLOW_START] = f"{index}:{float(slow_start_s)}"
+    if corrupt_ckpt:
+        env[ENV_CORRUPT_CKPT] = str(index)
+    return env
 
 
 def hang_until(event, items):

@@ -375,17 +375,27 @@ def test_loadgen_schedule_profiles():
 
 def test_loadgen_summarize_and_assert_2xx_message():
     lg = _load_script("loadgen")
-    results = [(200, 0.010, 5.0, None), (200, 0.020, 6.0, None),
-               (503, 0.001, None, None),
-               (0, 0.5, None, "ConnectionRefusedError: x")]
+    # (status, latency_s, queue_wait_ms, error_str, t_done_s)
+    results = [(200, 0.010, 5.0, None, 0.10),
+               (200, 0.020, 6.0, None, 0.90),
+               (503, 0.001, None, None, 0.20),
+               (0, 0.5, None, "ConnectionRefusedError: x", 0.50)]
     out = lg.summarize(results, wall=1.0)
     assert out["requests"] == 4 and out["error_rate"] == 0.5
     assert out["status"] == {"0": 1, "200": 2, "503": 1}
     assert out["p50_ms"] is not None and out["imgs_per_sec"] == 2.0
+    # availability excludes the shed 503 from the denominator: 2/3
+    assert out["availability"] == pytest.approx(2 / 3, abs=1e-4)
+    # transport error at 0.50 → first 2xx completion after it at 0.90
+    assert out["time_to_recover_s"] == pytest.approx(0.4, abs=1e-3)
     msg = lg.assert_2xx_failure(results)
     assert "2/4" in msg and "1x status 503" in msg
     assert "1x transport error" in msg and "ConnectionRefusedError" in msg
-    assert lg.assert_2xx_failure([(200, 0.01, 1.0, None)]) is None
+    assert lg.assert_2xx_failure([(200, 0.01, 1.0, None, 0.01)]) is None
+    # never hard-failed → no recovery metric; all-2xx availability is 1.0
+    clean = lg.summarize([(200, 0.01, 1.0, None, 0.01)], wall=1.0)
+    assert clean["availability"] == 1.0
+    assert clean["time_to_recover_s"] is None
 
 
 def test_perf_gate_slo_rows(tmp_path):
