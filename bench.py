@@ -47,6 +47,17 @@ prints one JSON line):
       and --mode infer is the serving tax (prep + batching + NMS); the
       output's "method" field says "engine" so ledger rows are never
       compared against forward-only numbers silently.
+  python bench.py --mode pipeline --auto-tune   # input-pipeline tuner:
+      sweep the (k steps/dispatch × loader workers × prefetch [×
+      --device-prep]) matrix through the real train hot loop
+      (mx_rcnn_tpu/train/pipeline.py), per-cell imgs/s + loader_wait/
+      dispatch/fetch_stall/assembly_wait breakdown; --auto-tune persists
+      the winner next to the program cache so train_end2end.py
+      --tuned-pipeline boots into it.  method: "pipeline"
+      (loader-inclusive), its own baseline key ("value_pipeline").
+  --workers-list/--prefetch-list on --mode loader / train-loader sweep
+      the standalone cells in ONE invocation (headline = best, every
+      cell in the JSON's "cells" array, metric suffixed _sweep).
 """
 
 from __future__ import annotations
@@ -274,7 +285,8 @@ def _synthetic_roidb(n=48):
     return SyntheticDataset(num_images=n, height=600, width=800).gt_roidb()
 
 
-def bench_train_loader(batch: int, network: str = "resnet101"):
+def bench_train_loader(batch: int, network: str = "resnet101",
+                       workers: int = 0, prefetch=None):
     """Loader-inclusive: cv2-free synthetic pixels, but the full production
     path otherwise — resize to bucket, host s2d, target padding, prefetch
     thread, host→device transfer ON the prefetch thread (the round-3
@@ -292,28 +304,38 @@ def bench_train_loader(batch: int, network: str = "resnet101"):
     from mx_rcnn_tpu.data.loader import AnchorLoader
 
     state, step, _, cfg = build(batch, network)
+    over = {}
+    if workers:
+        over["LOADER_WORKERS"] = workers
+    if prefetch is not None:
+        over["PREFETCH"] = int(prefetch)
+    if over:
+        cfg = cfg.replace(tpu=dataclasses.replace(cfg.tpu, **over))
     roidb = _synthetic_roidb()
     loader = AnchorLoader(roidb, cfg, batch, shuffle=True, seed=0)
     loader.put = jax.device_put  # double-buffer: transfer on prefetch thread
-    # warm the jit cache for every bucket the loader can emit
-    for b in loader:
-        state, m = step(state, b, jax.random.PRNGKey(0))
-    jax.block_until_ready(m)
+    try:
+        # warm the jit cache for every bucket the loader can emit
+        for b in loader:
+            state, m = step(state, b, jax.random.PRNGKey(0))
+        jax.block_until_ready(m)
 
-    best = None
-    for epoch in range(4):
-        imgs = 0
-        t0 = time.time()
-        for i, b in enumerate(loader):
-            state, m = step(state, b, jax.random.PRNGKey(i))
-            imgs += batch
-        _ = float(jax.device_get(m["total_loss"]))
-        best = max(best or 0.0, imgs / (time.time() - t0))
+        best = None
+        for epoch in range(4):
+            imgs = 0
+            t0 = time.time()
+            for i, b in enumerate(loader):
+                state, m = step(state, b, jax.random.PRNGKey(i))
+                imgs += batch
+            _ = float(jax.device_get(m["total_loss"]))
+            best = max(best or 0.0, imgs / (time.time() - t0))
+    finally:
+        loader.close_workers()
     return best
 
 
 def bench_host_loader(batch: int, network: str = "resnet101",
-                      workers: int = 0):
+                      workers: int = 0, prefetch=None):
     """Host input pipeline STANDALONE: the full AnchorLoader production
     path (cv2 resize to bucket, normalize, flip, host s2d, gt padding,
     batch assembly, prefetch queue) with no device step and no transfer —
@@ -325,9 +347,13 @@ def bench_host_loader(batch: int, network: str = "resnet101",
     from mx_rcnn_tpu.data.loader import AnchorLoader
 
     cfg = make_cfg(network)
+    over = {}
     if workers:
-        cfg = cfg.replace(tpu=dataclasses.replace(cfg.tpu,
-                                                  LOADER_WORKERS=workers))
+        over["LOADER_WORKERS"] = workers
+    if prefetch is not None:
+        over["PREFETCH"] = int(prefetch)
+    if over:
+        cfg = cfg.replace(tpu=dataclasses.replace(cfg.tpu, **over))
     roidb = _synthetic_roidb()
     loader = AnchorLoader(roidb, cfg, batch, shuffle=True, seed=0)
     for _ in loader:  # warmup epoch
@@ -343,6 +369,43 @@ def bench_host_loader(batch: int, network: str = "resnet101",
     finally:
         loader.close_workers()
     return best
+
+
+def _parse_int_list(spec) -> list:
+    """Comma-separated ints ("0,2,4") → [0, 2, 4]; None/"" → []."""
+    if not spec:
+        return []
+    return [int(tok) for tok in str(spec).split(",") if tok.strip() != ""]
+
+
+def bench_pipeline(args):
+    """Tuned-pipeline sweep (``mx_rcnn_tpu/train/pipeline.py``): drive the
+    (k steps/dispatch × loader workers × prefetch depth [× device-prep])
+    matrix through the REAL train hot loop — fresh AnchorLoader per cell,
+    the same producer-thread put / group-wrap hooks ``fit`` installs, one
+    shared step-program cache across cells — and report per-cell imgs/s
+    with the loader_wait / dispatch / fetch_stall / assembly_wait
+    breakdown.  ``--auto-tune`` persists the winning cell next to the
+    program cache so ``train_end2end.py --tuned-pipeline`` boots straight
+    into it.  Headline value = best cell's imgs/s."""
+    from mx_rcnn_tpu.train.pipeline import (PipelineSweep, parse_cells,
+                                            tuned_path)
+
+    cfg = make_cfg(args.network)
+    roidb = _synthetic_roidb(args.pipeline_images)
+    k_list = _parse_int_list(args.k_list) or [1, 2]
+    workers_list = _parse_int_list(args.workers_list) or [0, 2]
+    prefetch_list = _parse_int_list(args.prefetch_list) or [2]
+    cells = parse_cells(k_list, workers_list, prefetch_list,
+                        device_prep=((False, True) if args.device_prep
+                                     else (False,)))
+    sweep_out = args.sweep_out or os.path.join(
+        os.path.dirname(tuned_path()), "pipeline_sweep.jsonl")
+    sweep = PipelineSweep(cfg, roidb, batch=args.batch)
+    res = sweep.sweep(cells, epochs=args.pipeline_epochs, warmup_epochs=1,
+                      auto_tune=args.auto_tune, sweep_jsonl=sweep_out)
+    res["sweep_jsonl"] = sweep_out
+    return res
 
 
 def build_infer(batch: int, network: str = "resnet101"):
@@ -569,13 +632,48 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="train",
                     choices=["train", "loader", "train-loader", "infer",
-                             "infer-loader", "infer-mask", "serve"])
+                             "infer-loader", "infer-mask", "serve",
+                             "pipeline"])
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--loader-workers", type=int, default=0,
                     dest="loader_workers",
-                    help="loader mode: host input-pipeline worker "
-                         "processes (0 = the serial producer); non-zero "
-                         "suffixes the metric with _w{N}")
+                    help="loader/train-loader modes: host input-pipeline "
+                         "worker processes (0 = the serial producer); "
+                         "non-zero suffixes the metric with _w{N}")
+    ap.add_argument("--workers-list", default="", dest="workers_list",
+                    help="comma list of worker counts, e.g. 0,2,4 — "
+                         "loader/train-loader: sweep standalone cells "
+                         "(headline = best, every cell in the JSON); "
+                         "pipeline: the matrix's workers axis "
+                         "(default 0,2)")
+    ap.add_argument("--prefetch-list", default="", dest="prefetch_list",
+                    help="comma list of prefetch queue depths — "
+                         "loader/train-loader sweep axis / pipeline "
+                         "matrix axis (default: config PREFETCH; "
+                         "pipeline default 2)")
+    ap.add_argument("--k-list", default="", dest="k_list",
+                    help="pipeline mode: comma list of steps-per-dispatch "
+                         "group sizes (default 1,2)")
+    ap.add_argument("--auto-tune", action="store_true", dest="auto_tune",
+                    help="pipeline mode: persist the winning cell next to "
+                         "the program cache (train_end2end.py/"
+                         "train_alternate.py --tuned-pipeline reads it)")
+    ap.add_argument("--device-prep", action="store_true", dest="device_prep",
+                    help="pipeline mode: sweep device-side preprocessing "
+                         "as a matrix axis (each k×w×p cell runs host-prep "
+                         "AND device-prep)")
+    ap.add_argument("--pipeline-images", type=int, default=32,
+                    dest="pipeline_images",
+                    help="pipeline mode: synthetic roidb size per epoch")
+    ap.add_argument("--pipeline-epochs", type=int, default=1,
+                    dest="pipeline_epochs",
+                    help="pipeline mode: measured epochs per cell (one "
+                         "extra warmup epoch always runs first)")
+    ap.add_argument("--sweep-out", default="", dest="sweep_out",
+                    help="pipeline mode: per-cell JSONL path (telemetry-"
+                         "meta-shaped rows; scripts/telemetry_report.py "
+                         "renders the table).  Default: pipeline_sweep."
+                         "jsonl next to the program cache")
     ap.add_argument("--network", default=None,
                     help="config preset (e.g. resnet101, resnet101_fpn, "
                          "resnet101_fpn_mask); non-default appears in the "
@@ -629,6 +727,8 @@ def main():
     t_bench = time.perf_counter()
     infer_method = None
     opt_acc = None
+    sweep_cells = None
+    pipe = None
     if args.mode == "train":
         fn = bench_train_staged if args.legacy_dispatch else bench_train_chain
         if args.opt_acc_ab:
@@ -651,17 +751,40 @@ def main():
         else:
             value = fn(args.batch, args.network)
         metric = "train_imgs_per_sec_per_chip"
-    elif args.mode == "loader":
-        value = bench_host_loader(args.batch, args.network,
-                                  args.loader_workers)
-        metric = "loader_imgs_per_sec_host"
-        if args.loader_workers:
-            metric += f"_w{args.loader_workers}"
-        infer_method = "host_pipeline"  # no device in this number: never
-        # comparable to device/train/serve rows
-    elif args.mode == "train-loader":
-        value = bench_train_loader(args.batch, args.network)
-        metric = "train_imgs_per_sec_loader_inclusive"
+    elif args.mode in ("loader", "train-loader"):
+        fn = (bench_host_loader if args.mode == "loader"
+              else bench_train_loader)
+        metric = ("loader_imgs_per_sec_host" if args.mode == "loader"
+                  else "train_imgs_per_sec_loader_inclusive")
+        wl = _parse_int_list(args.workers_list)
+        pl = _parse_int_list(args.prefetch_list)
+        if wl or pl:
+            # reproducible standalone sweep: every (workers, prefetch)
+            # cell in the JSON, best as the headline.  _sweep keys the
+            # metric apart from single-cell rows of the same mode.
+            sweep_cells = []
+            for w in (wl or [args.loader_workers]):
+                for p in (pl or [None]):
+                    v = fn(args.batch, args.network, w, p)
+                    sweep_cells.append({
+                        "workers": w,
+                        "prefetch": p,
+                        "imgs_per_sec": round(v, 3)})
+            value = max(c["imgs_per_sec"] for c in sweep_cells)
+            metric += "_sweep"
+        else:
+            value = fn(args.batch, args.network, args.loader_workers)
+            if args.loader_workers:
+                metric += f"_w{args.loader_workers}"
+        if args.mode == "loader":
+            infer_method = "host_pipeline"  # no device in this number:
+            # never comparable to device/train/serve rows
+    elif args.mode == "pipeline":
+        pipe = bench_pipeline(args)
+        value = pipe["best"]["imgs_per_sec"]
+        metric = "train_imgs_per_sec_pipeline"
+        infer_method = "pipeline"  # loader-inclusive real-hot-loop sweep:
+        # never comparable to chain/staged dispatch-free rows
     elif args.mode == "infer":
         fn = bench_infer_staged if args.legacy_dispatch else bench_infer_chain
         value = fn(args.batch, args.network)
@@ -732,6 +855,30 @@ def main():
             vs = None
             baseline_recorded = True
         baseline_method = "staged" if args.legacy_dispatch else "chain"
+    elif args.mode == "pipeline" and not args.cfg:
+        # the pipeline series gets its own baseline key per (batch,
+        # network): the number is loader-inclusive and box-dependent,
+        # never comparable to the dispatch-free chain/staged train rows
+        # (and perf_gate groups by baseline_method, so the r05 chain row
+        # is never scored against this series)
+        key = "value_pipeline"
+        if args.batch != 1:
+            key += f"_b{args.batch}"
+        if args.network != "resnet101":
+            key += f"_{args.network}"
+        base_doc = {}
+        if os.path.exists(BASELINE_FILE):
+            with open(BASELINE_FILE) as f:
+                base_doc = json.load(f)
+        base = base_doc.get(key)
+        if base is None:  # first pipeline run of this shape: record it
+            base_doc[key] = value
+            with open(BASELINE_FILE, "w") as f:
+                json.dump(base_doc, f)
+            baseline_recorded = True
+        else:
+            vs = round(value / base, 3)
+        baseline_method = "pipeline"
 
     out = {
         "metric": metric,
@@ -754,6 +901,22 @@ def main():
         out["warmup_compile_s"] = serve_warmup_s
     if opt_acc is not None:
         out["opt_acc"] = opt_acc
+    if sweep_cells is not None:
+        out["cells"] = sweep_cells
+    if pipe is not None:
+        reg = pipe.get("registry", {})
+        out["pipeline"] = {
+            "best": pipe["best"],
+            "cells": pipe["cells"],
+            # the registry proof: programs stays flat across cells that
+            # share k (no per-cell recompiles), aot_hit counts warm boots
+            "programs": len(reg.get("programs", [])),
+            "registry_counters": reg.get("counters", {}),
+            "sweep_jsonl": pipe.get("sweep_jsonl"),
+        }
+        if "tuned_file" in pipe:
+            out["pipeline"]["tuned_file"] = pipe["tuned_file"]
+            out["pipeline"]["tuned"] = pipe["tuned"]
     if tel.enabled:
         tel.gauge(f"bench/{metric}", value)
     obs.close(extra={"bench": out})

@@ -260,6 +260,16 @@ class TPUConfig:
     # (BASELINE.md batch-scaling table).  Param tree and numerics are
     # unchanged; off by default pending the on-chip A/B.
     REMAT_BACKBONE: bool = False
+    # device-side preprocessing (data/device_prep.py): train loaders emit
+    # raw bucket-staged uint8 pixels and a jitted per-bucket program does
+    # resize/flip/normalize/pad (and HOST_S2D) on device, overlapped with
+    # the step via the prefetch thread.  Off (default) keeps the host
+    # numpy path bit-identical to before the feature existed.  Train-path
+    # only: TestLoader and the serve engine always use the host path.
+    DEVICE_PREP: bool = False
+    # output dtype of the device preprocess program ("float32" or
+    # "bfloat16") — the host path is float32-only
+    DEVICE_PREP_DTYPE: str = "float32"
 
 
 @dataclass(frozen=True)

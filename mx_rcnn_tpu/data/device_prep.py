@@ -1,0 +1,195 @@
+"""Device-side image preprocessing: the per-sample resize/flip/normalize/
+pad hot path as ONE jitted program per (batch, bucket, dtype).
+
+Host contract (``data/image.py::stage_raw_to_bucket`` via ``data/loader.py``
+with ``cfg.tpu.DEVICE_PREP``): the loader ships raw uint8 pixels parked in
+the output bucket shape plus three sidecar keys —
+
+* ``images``     (B, Hb, Wb, 3) uint8 — raw bytes, valid extent = raw_hw
+* ``raw_hw``     (B, 2) int32   — raw (h, w) inside the staging buffer
+* ``prep_ratio`` (B,) float32   — exact dst→src factor (1/s; 1 if staged
+  pre-shrunk)
+* ``flip``       (B,) bool      — mirror the SOURCE coordinate on device
+* ``im_info``    (B, 3) float32 — [eh, ew, s], identical to the host path
+
+The program reproduces cv2's ``resize(fx=s)`` INTER_LINEAR semantics
+exactly: per output pixel the source coordinate is
+``(dst + 0.5) * ratio - 0.5`` with edge clamp, bilinear in float32.  Because mean/std normalization is affine and
+bilinear weights sum to 1, normalize-after-resize here equals the host
+path's resize-after-normalize up to float32 rounding — parity is pinned by
+``tests/test_device_prep.py``.  Flip mirrors the source x coordinate
+(``sx -> (w-1) - sx``) which equals flipping the raw image before the
+resize; gt boxes are already flipped on the roidb records, so the host
+ships untouched bytes either way.
+
+Programs are registered through the PR-7 ``compile/registry.py`` under
+kind ``"device_prep"`` — one program per (batch, bucket, s2d, dtype),
+first-dispatch accounted via ``note_dispatch`` so the AOT marker manifest
+and warm-start counters cover preprocessing like every other program.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+KIND = "device_prep"
+
+
+def _prep_one(raw, raw_hw, ratio, im_info, flip, mean, std, s2d: bool,
+              out_dtype):
+    """One image: (Hb, Wb, 3) uint8 -> (Hb, Wb, 3) or s2d (Hb/2, Wb/2, 12)."""
+    hb, wb = raw.shape[0], raw.shape[1]
+    hi, wi = raw_hw[0], raw_hw[1]
+    h = hi.astype(jnp.float32)
+    w = wi.astype(jnp.float32)
+
+    # cv2 INTER_LINEAR center-aligned sampling with border-replicate clamp.
+    # ``ratio`` is the EXACT dst→src factor (1/s on both axes) — cv2 maps
+    # with the given fx/fy, not with raw/effective per axis, and the two
+    # differ whenever dim*s is fractional (see stage_raw_to_bucket).
+    ys = (jnp.arange(hb, dtype=jnp.float32) + 0.5) * ratio - 0.5
+    xs = (jnp.arange(wb, dtype=jnp.float32) + 0.5) * ratio - 0.5
+    xs = jnp.where(flip, (w - 1.0) - xs, xs)
+    ys = jnp.clip(ys, 0.0, h - 1.0)
+    xs = jnp.clip(xs, 0.0, w - 1.0)
+
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    y0i = y0.astype(jnp.int32)
+    x0i = x0.astype(jnp.int32)
+    y1i = jnp.minimum(y0i + 1, hi - 1)
+    x1i = jnp.minimum(x0i + 1, wi - 1)
+
+    img = raw.astype(jnp.float32)
+    r0 = img[y0i]                     # (Hb, Wb_raw, 3)
+    r1 = img[y1i]
+    top = r0[:, x0i] * (1.0 - wx) + r0[:, x1i] * wx
+    bot = r1[:, x0i] * (1.0 - wx) + r1[:, x1i] * wx
+    v = top * (1.0 - wy) + bot * wy
+
+    v = (v - mean) / std              # affine: commutes with the resample
+
+    ehi = im_info[0].astype(jnp.int32)
+    ewi = im_info[1].astype(jnp.int32)
+    valid = ((jnp.arange(hb) < ehi)[:, None]
+             & (jnp.arange(wb) < ewi)[None, :])
+    v = jnp.where(valid[:, :, None], v, 0.0)
+
+    if s2d:  # mirror data/image.py::space_to_depth2 (channel order di,dj,c)
+        c = v.shape[-1]
+        v = (v.reshape(hb // 2, 2, wb // 2, 2, c)
+             .transpose(0, 2, 1, 3, 4)
+             .reshape(hb // 2, wb // 2, 4 * c))
+    return v.astype(out_dtype)
+
+
+class DevicePrep:
+    """Owns the jitted preprocess program and the loader/trainer glue.
+
+    ``put`` is the k=1 producer-thread hook (replaces ``jax.device_put``);
+    ``put_stacked`` preps a k-stacked group batch for the
+    ``--steps-per-dispatch`` wrap path.  Both consume the raw sidecar keys
+    and emit the exact batch layout the host path produces (``images``
+    float32/bf16 + ``im_info`` + gt keys), so every downstream consumer —
+    train step, grouping, telemetry shape accounting — is unchanged.
+    """
+
+    def __init__(self, cfg, registry=None):
+        net = cfg.network
+        self.cfg = cfg
+        self._registry = registry
+        self._mean = jnp.asarray(net.PIXEL_MEANS, jnp.float32)
+        self._std = jnp.asarray(net.PIXEL_STDS, jnp.float32)
+        self._s2d = bool(net.HOST_S2D)
+        dt = getattr(cfg.tpu, "DEVICE_PREP_DTYPE", "float32")
+        if dt not in ("float32", "bfloat16"):
+            raise ValueError(f"DEVICE_PREP_DTYPE must be float32 or "
+                             f"bfloat16, got {dt!r}")
+        self.out_dtype = jnp.bfloat16 if dt == "bfloat16" else jnp.float32
+        if registry is not None:
+            registry.register(KIND, self._build)
+            self._fn = registry.lookup(KIND)
+        else:
+            self._fn = self._build()
+
+    def _build(self):
+        mean, std, s2d, dt = self._mean, self._std, self._s2d, self.out_dtype
+
+        def batch_prep(raw, raw_hw, ratio, im_info, flip):
+            one = lambda r, hw, rt, ii, f: _prep_one(r, hw, rt, ii, f,
+                                                     mean, std, s2d, dt)
+            return jax.vmap(one)(raw, raw_hw, ratio, im_info, flip)
+
+        return jax.jit(batch_prep)
+
+    # -- hooks -----------------------------------------------------------
+
+    def _run(self, raw, raw_hw, ratio, im_info, flip):
+        """Dispatch the program with registry first-seen accounting."""
+        reg = self._registry
+        first = reg.note_dispatch(KIND, raw.shape) if reg is not None else False
+        t0 = time.perf_counter() if first else 0.0
+        out = self._fn(raw, raw_hw, ratio, im_info, flip)
+        if first:
+            out.block_until_ready()
+            reg.record_compile_seconds(KIND, raw.shape,
+                                       time.perf_counter() - t0)
+        return out
+
+    def put(self, batch: dict) -> dict:
+        """k=1 loader ``put`` hook: raw host batch -> final device batch."""
+        batch = dict(batch)
+        raw = jax.device_put(batch.pop("images"))
+        raw_hw = jax.device_put(batch.pop("raw_hw"))
+        ratio = jax.device_put(batch.pop("prep_ratio"))
+        flip = jax.device_put(batch.pop("flip"))
+        out = jax.device_put(batch)
+        out["images"] = self._run(raw, raw_hw, ratio, out["im_info"], flip)
+        return out
+
+    def put_stacked(self, stacked: dict) -> dict:
+        """k-group hook: leaves shaped (k, B, ...) -> prepped (k, B, ...).
+
+        The k·B images run as ONE prep dispatch (reshape to a flat batch,
+        prep, fold back) so steps-per-dispatch adds exactly one program
+        per k, not per (k, position)."""
+        stacked = dict(stacked)
+        raw = np.asarray(stacked.pop("images"))
+        raw_hw = np.asarray(stacked.pop("raw_hw"))
+        ratio = np.asarray(stacked.pop("prep_ratio"))
+        flip = np.asarray(stacked.pop("flip"))
+        k, b = raw.shape[:2]
+        out = jax.device_put(stacked)
+        draw = jax.device_put(raw.reshape((k * b,) + raw.shape[2:]))
+        dhw = jax.device_put(raw_hw.reshape(k * b, 2))
+        drt = jax.device_put(ratio.reshape(k * b))
+        dfl = jax.device_put(flip.reshape(k * b))
+        dii = out["im_info"].reshape(k * b, 3)
+        imgs = self._run(draw, dhw, drt, dii, dfl)
+        out["images"] = imgs.reshape((k, b) + imgs.shape[1:])
+        return out
+
+
+def maybe_device_prep(cfg, registry=None, plan=None) -> Optional[DevicePrep]:
+    """Build a DevicePrep when the config asks for one and the topology
+    supports it.  Mesh plans are host-prep only for now (the prep output
+    would need the plan's input sharding); callers downgrade with a
+    warning rather than silently feeding raw uint8 to the step."""
+    if not getattr(cfg.tpu, "DEVICE_PREP", False):
+        return None
+    if plan is not None:
+        raise ValueError(
+            "cfg.tpu.DEVICE_PREP is not supported under a mesh plan yet — "
+            "strip it before building loaders (tools.common."
+            "strip_device_prep_for_mesh)")
+    return DevicePrep(cfg, registry=registry)

@@ -92,6 +92,56 @@ def resize_to_bucket(im: np.ndarray, scale: Tuple[int, int], stride: int):
     return out, s, (eh, ew)
 
 
+def stage_raw_to_bucket(im: np.ndarray, scale: Tuple[int, int], stride: int):
+    """Stage RAW uint8 pixels into the orientation's bucket for device-side
+    preprocessing (``data/device_prep.py``).
+
+    The device program resamples from the raw extent (h, w) to the effective
+    extent (eh, ew) with the same center-aligned bilinear rule cv2 uses, so
+    the host only has to park the untouched bytes in a static buffer — no
+    float conversion, no resize, no flip (the device mirrors the source
+    coordinate instead).
+
+    Returns ``(staged (Hb, Wb, 3) uint8, raw_hw (2,) int32, ratio ()
+    float32, im_info (3,) float32)`` where ``raw_hw`` is the valid raw
+    extent inside the staging buffer, ``ratio`` is the dst→src coordinate
+    factor the device must use on BOTH axes, and ``im_info = [eh, ew, s]``
+    matches the host-path contract bit-for-bit (same ``compute_scale``,
+    same rounding).
+
+    ``ratio`` is ``1/s`` — NOT ``raw/effective`` per axis: cv2's
+    ``resize(fx=s)`` maps ``src = (dst + 0.5)/s - 0.5`` with the exact
+    given factor even though the output dims round to integers, so a
+    per-axis ``h/eh`` ratio diverges whenever ``h*s`` is fractional
+    (measured up to ~1.3 normalized units on a 120×200 raw).
+
+    When the raw image is LARGER than the bucket (strong downscale), the
+    raw bytes cannot be staged whole; we pre-shrink on host with the same
+    cv2 call the host path uses so the device resample degenerates to an
+    identity gather (ratio = 1).  That uint8-domain shrink is the one
+    documented fidelity divergence vs the host float path — oversized
+    raws only, bounded by uint8 rounding.
+    """
+    h, w = im.shape[:2]
+    s = compute_scale(h, w, scale)
+    hb, wb = bucket_shape(scale, stride, landscape=(w >= h))
+    if h > hb or w > wb:
+        im = cv2.resize(im, None, None, fx=s, fy=s,
+                        interpolation=cv2.INTER_LINEAR)[:hb, :wb]
+        h, w = im.shape[:2]
+        eh, ew, ratio = h, w, 1.0
+    else:
+        # cv2.resize(fx=s) computes dsize = cvRound(dim * s) (round-half-
+        # even, same as python round) — mirror it so im_info matches the
+        # host path bit-for-bit.
+        eh, ew = min(int(round(h * s)), hb), min(int(round(w * s)), wb)
+        ratio = 1.0 / s
+    out = np.zeros((hb, wb) + im.shape[2:], np.uint8)
+    out[:h, :w] = im
+    return (out, np.asarray([h, w], np.int32), np.float32(ratio),
+            np.asarray([eh, ew, s], np.float32))
+
+
 def space_to_depth2(im: np.ndarray) -> np.ndarray:
     """2×2 space-to-depth: (H, W, C) → (H/2, W/2, 4C), channel order
     (di, dj, c) — exactly the regroup ``models.backbones.StemConvS2D``

@@ -27,7 +27,8 @@ import numpy as np
 from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.image import (get_image, resize_to_bucket,
-                                    space_to_depth2, transform_image)
+                                    space_to_depth2, stage_raw_to_bucket,
+                                    transform_image)
 from mx_rcnn_tpu.logger import logger
 
 # Fault isolation (train loaders): one missing/corrupt image substitutes a
@@ -60,13 +61,25 @@ def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
 
     ``with_masks``: rasterize gt masks (train loaders under HAS_MASK only —
     eval and proposal loaders never consume them)."""
+    device_prep = getattr(cfg.tpu, "DEVICE_PREP", False)
+    flipped = bool(rec.get("flipped", False))
     if "image_array" in rec:  # synthetic dataset ships pixels inline
         im = rec["image_array"]
-        if rec.get("flipped", False):
+        if flipped and not device_prep:  # device prep mirrors on device
             im = im[:, ::-1, :]
     else:
-        im = get_image(rec["image"], flipped=rec.get("flipped", False))
-    padded, im_info = prepare_image(im, cfg, scale)
+        im = get_image(rec["image"], flipped=flipped and not device_prep)
+    if device_prep:
+        # ship raw uint8 staged into the output bucket; the jitted
+        # device_prep program does resize/flip/normalize/pad (+ s2d).
+        # The pixel key stays "images" so every shape/dtype-agnostic
+        # consumer (worker shm handover, group assembly, _stack) flows
+        # unchanged; the sidecar keys are consumed by DevicePrep hooks.
+        stride = max(cfg.network.IMAGE_STRIDE, cfg.network.RPN_FEAT_STRIDE)
+        padded, raw_hw, ratio, im_info = stage_raw_to_bucket(
+            np.ascontiguousarray(im), scale, stride)
+    else:
+        padded, im_info = prepare_image(im, cfg, scale)
     s = float(im_info[2])
 
     g = cfg.tpu.MAX_GT
@@ -80,6 +93,10 @@ def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
         valid[:n] = True
     out = dict(images=padded, im_info=im_info,
                gt_boxes=boxes, gt_classes=classes, gt_valid=valid)
+    if device_prep:
+        out["raw_hw"] = raw_hw
+        out["prep_ratio"] = ratio
+        out["flip"] = np.bool_(flipped)
     if with_masks and cfg.network.HAS_MASK:
         from mx_rcnn_tpu.data.mask import rasterize_gt_masks
 
@@ -210,6 +227,13 @@ class _Prefetcher:
             return False
 
         def run():
+            # Re-stamp the heartbeat the moment the producer THREAD starts:
+            # the watchdog clock otherwise runs from __init__, and a slow
+            # epoch boundary (worker-pool spawn, scheduler delay between
+            # construction and thread start) would count against the budget
+            # and trip a spurious prefetch_watchdog flight dump on a fresh
+            # prefetcher.
+            self._beat = time.monotonic()
             tel = self._tel
             try:
                 if not tel.enabled:  # untimed hot path: one check per epoch
@@ -515,6 +539,13 @@ class TestLoader:
 
     def __init__(self, roidb: list, cfg: Config, batch_size: int = 1):
         self.roidb = roidb
+        if getattr(cfg.tpu, "DEVICE_PREP", False):
+            # device prep is a TRAIN-path feature; eval stays on the
+            # bit-identical host transform (Predictor has no prep hook)
+            import dataclasses as _dc
+
+            cfg = _dc.replace(cfg, tpu=_dc.replace(cfg.tpu,
+                                                   DEVICE_PREP=False))
         self.cfg = cfg
         self.batch_size = batch_size
         # double-buffering hook (Predictor.batch_put): transfers the

@@ -12,7 +12,12 @@ decoupling tf.data and PyTorch's multi-worker DataLoader exist for:
   s2d) and writes the finished pixel array into a preallocated
   ``multiprocessing.shared_memory`` ring slot — ZERO pickle copies for
   pixel data; only small metadata (im_info, gt targets, shapes) crosses
-  the result queue.
+  the result queue.  Under ``cfg.tpu.DEVICE_PREP`` the "pixels" are the
+  raw uint8 staging buffer instead (``stage_raw_to_bucket``) — same
+  ``images`` key, same bucket extents, strictly smaller than the float
+  slot the ring is sized for — and the prep sidecars (``raw_hw``,
+  ``prep_ratio``, ``flip``) ride the metadata path; nothing here is
+  shape- or dtype-special-cased for it.
 * The parent's order-preserving collector hands samples back IN TASK
   ORDER regardless of worker skew, so batches assemble exactly as the
   serial producer would have built them and the existing prefetch queue /

@@ -96,6 +96,7 @@ def aggregate(events: Iterable[dict]) -> dict:
     hists: dict = {}
     ranks = set()
     meta: dict = {}
+    pipeline: list = []
     for e in events:
         kind = e.get("kind")
         name = e.get("name")
@@ -129,12 +130,20 @@ def aggregate(events: Iterable[dict]) -> dict:
             if h is None:
                 h = hists[name] = Hist()
             h.observe(float(e["value"]))
-        elif kind == "meta" and name == "run" and not meta:
-            meta = dict(e.get("fields", {}))
+        elif kind == "meta":
+            if name == "run" and not meta:
+                meta = dict(e.get("fields", {}))
+            elif name == "pipeline_cell":
+                # one row per tuning-sweep cell (train/pipeline.py —
+                # also the shape bench.py --mode pipeline writes to its
+                # --sweep-out JSONL, so that artifact folds here too)
+                pipeline.append(dict(e.get("fields", {})))
+    out_extra = {"pipeline": pipeline} if pipeline else {}
     return {
         "schema": SCHEMA_VERSION,
         "ranks": sorted(ranks),
         "meta": meta,
+        **out_extra,
         "spans": {k: {"count": c, "total_s": t, "mean_s": t / max(c, 1),
                       "min_s": lo, "max_s": hi}
                   for k, (c, t, lo, hi) in sorted(spans.items())},
@@ -199,6 +208,23 @@ def render_table(summary: dict) -> str:
             lines.append(f"{name:<34}{g['count']:>8}{g['mean']:>10.3f}"
                          f"{g['min']:>10.3f}{g['max']:>10.3f}"
                          f"{g['last']:>10.3f}")
+    pipeline = summary.get("pipeline", [])
+    if pipeline:
+        # tuning-sweep cells, fastest first (bench.py --mode pipeline /
+        # train/pipeline.py): the full wait breakdown per cell, so "which
+        # knob moved the needle and where did the time go" is one block
+        lines.append("")
+        lines.append(f"{'pipeline cell':<18}{'imgs/s':>10}{'loader_s':>10}"
+                     f"{'assembly_s':>11}{'dispatch_s':>11}{'wait%':>8}")
+        for row in sorted(pipeline,
+                          key=lambda r: -(r.get("imgs_per_sec") or 0.0)):
+            lines.append(
+                f"{row.get('cell', '?'):<18}"
+                f"{row.get('imgs_per_sec') or 0.0:>10.3f}"
+                f"{row.get('loader_wait_s') or 0.0:>10.3f}"
+                f"{row.get('assembly_wait_s') or 0.0:>11.3f}"
+                f"{row.get('dispatch_s') or 0.0:>11.3f}"
+                f"{100 * (row.get('loader_wait_frac') or 0.0):>7.1f}%")
     hists = summary.get("hists", {})
     if hists:
         lines.append("")

@@ -109,6 +109,24 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
                                  "a loop body — see train/trainer.py fit "
                                  "docstring; applies to every fit-based "
                                  "driver, alternate stages included)")
+        parser.add_argument("--prefetch", type=int, default=None,
+                            metavar="DEPTH",
+                            help="host→device prefetch queue depth "
+                                 "(tpu.PREFETCH; default from config)")
+        parser.add_argument("--device-prep", action="store_true",
+                            dest="device_prep",
+                            help="run the per-sample resize/flip/normalize/"
+                                 "pad hot path on device as a jitted "
+                                 "program (data/device_prep.py; default "
+                                 "off = host numpy path, bit-identical to "
+                                 "previous releases; train loaders only)")
+        parser.add_argument("--tuned-pipeline", action="store_true",
+                            dest="tuned_pipeline",
+                            help="boot into the input-pipeline cell "
+                                 "persisted by `bench.py --mode pipeline "
+                                 "--auto-tune` (k steps/dispatch, loader "
+                                 "workers, prefetch depth, device-prep); "
+                                 "explicit flags win per field")
         # fault tolerance (train/resilience.py): --save-every-n-steps,
         # --auto-resume, --nan-policy on every fit-based driver
         add_resilience_args(parser)
@@ -174,6 +192,10 @@ def config_from_args(args, train: bool = True) -> Config:
     overrides = parse_cfg_overrides(getattr(args, "cfg", []))
     if getattr(args, "loader_workers", None) is not None:
         overrides["tpu__LOADER_WORKERS"] = int(args.loader_workers)
+    if getattr(args, "prefetch", None) is not None:
+        overrides["tpu__PREFETCH"] = int(args.prefetch)
+    if getattr(args, "device_prep", False):
+        overrides["tpu__DEVICE_PREP"] = True
     if train:
         if args.lr is not None:
             overrides["TRAIN__LR"] = args.lr
@@ -200,6 +222,26 @@ def config_from_args(args, train: bool = True) -> Config:
         # absorb it in the reference contract; random init cannot)
         cfg = cfg.replace(network=dataclasses.replace(
             cfg.network, PIXEL_STDS=(127.0, 127.0, 127.0)))
+    if train and getattr(args, "tuned_pipeline", False):
+        # boot into the persisted tuned pipeline cell (bench.py --mode
+        # pipeline --auto-tune).  Looked up AFTER every other override is
+        # applied — the tuned key is a tuned-field-normalized digest of
+        # exactly this config.
+        from mx_rcnn_tpu.train.pipeline import apply_tuned_to_args
+
+        cfg = apply_tuned_to_args(args, cfg)
+    return cfg
+
+
+def strip_device_prep_for_mesh(cfg: Config, plan) -> Config:
+    """Device-side preprocessing is single-mesh only for now (the prep
+    output would need the plan's input sharding) — drivers downgrade to
+    the host path with a warning instead of fit raising mid-boot."""
+    if plan is not None and getattr(cfg.tpu, "DEVICE_PREP", False):
+        logger.warning("--device-prep is not supported under a mesh plan "
+                       "yet — using the host preprocessing path")
+        cfg = cfg.replace(tpu=dataclasses.replace(cfg.tpu,
+                                                  DEVICE_PREP=False))
     return cfg
 
 
@@ -366,16 +408,42 @@ class CappedLoader:
         self._inner.skip_next(m)
         self._skip = m
 
+    # fit() owns the loader put/wrap hooks; proxy them to the wrapped
+    # loader so a capped run keeps producer-thread transfer/group
+    # assembly (k>1 dispatch groups and device-prep both ride these) —
+    # without the proxy fit would fall back to synchronous consumer-side
+    # handling for every --num-steps run.
+    @property
+    def put(self):
+        return getattr(self._inner, "put", None)
+
+    @put.setter
+    def put(self, v):
+        self._inner.put = v
+
+    @property
+    def wrap(self):
+        return getattr(self._inner, "wrap", None)
+
+    @wrap.setter
+    def wrap(self, v):
+        self._inner.wrap = v
+
     def __iter__(self):
         skip, self._skip = self._skip, 0
         budget = max(self.steps_per_epoch - skip, 0)
         it = iter(self._inner)
-        for i, batch in enumerate(it):
-            if i >= budget:
+        used = 0
+        for batch in it:
+            if used >= budget:
                 close = getattr(it, "close", None)
                 if close:
                     close()
                 break
+            # a group-wrap item ("group", n, data) advances the step
+            # budget by n — --num-steps counts steps, not dispatches
+            used += (batch[1] if isinstance(batch, tuple)
+                     and len(batch) == 3 else 1)
             yield batch
 
 

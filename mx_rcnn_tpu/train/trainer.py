@@ -55,7 +55,14 @@ def _runtime_owned(tree):
         tree)
 
 
-def _make_group_wrap(k: int, plan: Optional[MeshPlan]):
+# Tuned steady state must hide the input pipeline: the fraction of epoch
+# wall spent blocked on the loader beyond this trips a telemetry counter
+# + flight-recorder-visible meta event (train/pipeline.py sweeps use the
+# same threshold to mark a cell as loader-bound).
+LOADER_WAIT_TRIPWIRE_FRAC = 0.10
+
+
+def _make_group_wrap(k: int, plan: Optional[MeshPlan], prep=None):
     """Producer-thread group assembly for ``steps_per_dispatch=k``.
 
     Returns a generator transform (the loader ``wrap`` hook): stacks k
@@ -67,10 +74,13 @@ def _make_group_wrap(k: int, plan: Optional[MeshPlan]):
     bucket), as does the epoch remainder.  Items arrive at the consumer
     tagged ``(kind, n_batches, on_device_data)``.
     """
-    put1 = ((lambda b: shard_batch(plan, b)) if plan is not None
-            else jax.device_put)
-    putk = ((lambda s: shard_stacked_batch(plan, s)) if plan is not None
-            else jax.device_put)
+    if prep is not None:  # device-side preprocessing (plan is None here)
+        put1, putk = prep.put, prep.put_stacked
+    else:
+        put1 = ((lambda b: shard_batch(plan, b)) if plan is not None
+                else jax.device_put)
+        putk = ((lambda s: shard_stacked_batch(plan, s)) if plan is not None
+                else jax.device_put)
 
     def wrap(gen):
         buf = []
@@ -304,6 +314,31 @@ def fit(cfg: Config, model, params, train_loader,
                                       sentinel=res.sentinel,
                                       skip_nonfinite=res.skip_nonfinite)
                 if k > 1 else None)
+    # recompile tracking + device-prep program home: jit caches one
+    # program per (step fn, bucket shape), so the first dispatch of each
+    # pair is the compile.  The program registry mirrors that cache (fit
+    # builds fresh step fns, so per-fit is exact), makes mixed-bucket
+    # epochs show their true compile cost in the telemetry stream, and —
+    # with a persistent program cache configured — accounts each first
+    # dispatch as an AOT disk load vs an XLA compile.  Built BEFORE the
+    # loader hooks so the device_prep program registers alongside the
+    # step programs.
+    from mx_rcnn_tpu.compile import ProgramRegistry
+
+    registry = ProgramRegistry(cfg, dtype=cfg.tpu.COMPUTE_DTYPE
+                               if cfg.tpu.COMPUTE_DTYPE in
+                               ("float32", "bfloat16") else "float32",
+                               plan=plan)
+
+    # device-side preprocessing: when the config asks for it, the loader
+    # is already emitting raw uint8 batches (+ raw_hw/flip sidecar keys)
+    # and a DevicePrep hook must stand where device_put used to — raw
+    # bytes reaching the step fn would be garbage.  Mesh plans raise in
+    # maybe_device_prep (drivers strip the flag with a warning first).
+    from mx_rcnn_tpu.data.device_prep import maybe_device_prep
+
+    prep = maybe_device_prep(cfg, registry=registry, plan=plan)
+
     # device double-buffering: loaders that expose a ``put`` hook transfer
     # each batch from their prefetch thread (overlapping the previous
     # step's compute) instead of synchronously inside step dispatch; at
@@ -315,13 +350,17 @@ def fit(cfg: Config, model, params, train_loader,
     # would re-transfer the wrap's already-on-device items).
     loader_wraps = False
     if hasattr(train_loader, "wrap"):
-        train_loader.wrap = _make_group_wrap(k, plan) if k > 1 else None
+        train_loader.wrap = (_make_group_wrap(k, plan, prep=prep)
+                             if k > 1 else None)
         loader_wraps = k > 1
     loader_puts = False
     if hasattr(train_loader, "put"):
         if k == 1 and not loader_wraps:
-            train_loader.put = ((lambda b: shard_batch(plan, b))
-                                if plan is not None else jax.device_put)
+            if prep is not None:
+                train_loader.put = prep.put
+            else:
+                train_loader.put = ((lambda b: shard_batch(plan, b))
+                                    if plan is not None else jax.device_put)
             loader_puts = True
         else:  # the wrap transfers its own items — put must stay out
             train_loader.put = None
@@ -384,20 +423,6 @@ def fit(cfg: Config, model, params, train_loader,
 
         profile_dir = os.path.join(profile_dir,
                                    f"rank{jax.process_index()}")
-    # recompile tracking: jit caches one program per (step fn, bucket
-    # shape), so the first dispatch of each pair is the compile.  The
-    # program registry mirrors that cache (fit builds fresh step fns, so
-    # per-fit is exact), makes mixed-bucket epochs show their true
-    # compile cost in the telemetry stream instead of as unexplained
-    # slow steps, and — with a persistent program cache configured —
-    # accounts each first dispatch as an AOT disk load vs an XLA compile.
-    from mx_rcnn_tpu.compile import ProgramRegistry
-
-    registry = ProgramRegistry(cfg, dtype=cfg.tpu.COMPUTE_DTYPE
-                               if cfg.tpu.COMPUTE_DTYPE in
-                               ("float32", "bfloat16") else "float32",
-                               plan=plan)
-
     def note_dispatch(fn_kind, shape):
         if registry.note_dispatch(f"train_{fn_kind}", shape):
             tel.counter("train/recompile")
@@ -540,6 +565,11 @@ def fit(cfg: Config, model, params, train_loader,
                 pending = metrics
             elif multi_fn is None:
                 batch = item
+                if prep is not None and not loader_puts:
+                    # loader without a put hook under device prep: the
+                    # batch is still raw uint8 + sidecars — prep it here
+                    # (synchronous; only hook-less wrapper loaders hit it)
+                    batch = prep.put(batch)
                 note_dispatch("single", batch["images"].shape)
                 if plan is not None and not loader_puts:
                     batch = shard_batch(plan, batch)
@@ -555,19 +585,24 @@ def fit(cfg: Config, model, params, train_loader,
                 if buf and buf[0]["images"].shape != batch["images"].shape:
                     for b in buf:
                         key, sub = jax.random.split(key)
-                        note_dispatch("single", b["images"].shape)
-                        if plan is not None:
+                        if prep is not None:
+                            b = prep.put(b)
+                        elif plan is not None:
                             b = shard_batch(plan, b)
+                        note_dispatch("single", b["images"].shape)
                         state, metrics = step_fn(state, b, sub)
                     pending = metrics
                     buf = []
                 buf.append(batch)
                 if len(buf) == k:
                     stacked = jax.tree.map(lambda *xs: np.stack(xs), *buf)
+                    if prep is not None:
+                        stacked = prep.put_stacked(stacked)
+                    elif plan is not None:
+                        stacked = shard_stacked_batch(plan, stacked)
+                    else:
+                        stacked = jax.device_put(stacked)
                     note_dispatch("group", stacked["images"].shape)
-                    stacked = (shard_stacked_batch(plan, stacked)
-                               if plan is not None
-                               else jax.device_put(stacked))
                     state, metrics = multi_fn(state, stacked, sub)
                     pending = metrics
                     buf = []
@@ -633,9 +668,11 @@ def fit(cfg: Config, model, params, train_loader,
             t_disp = time.perf_counter()
             for b in buf:
                 key, sub = jax.random.split(key)
-                note_dispatch("single", b["images"].shape)
-                if plan is not None:
+                if prep is not None:
+                    b = prep.put(b)
+                elif plan is not None:
                     b = shard_batch(plan, b)
+                note_dispatch("single", b["images"].shape)
                 state, metrics = step_fn(state, b, sub)
             pending = metrics
             dt_disp = time.perf_counter() - t_disp
@@ -657,6 +694,28 @@ def fit(cfg: Config, model, params, train_loader,
         ep_wall = time.perf_counter() - ep_t0
         tel.add("train/epoch", ep_wall)
         tel.counter("train/steps", consumed - start_consumed)
+        # tuned-pipeline tripwire: a saturated input pipeline keeps the
+        # consumer's loader wait ≈ 0; spending more than the threshold
+        # fraction of epoch wall blocked on the loader means the tuned
+        # (k, workers, prefetch) cell no longer hides host work on this
+        # box — surfaced as a counter + meta event so perf triage and the
+        # pipeline sweep read the same signal.  Needs a few steps of
+        # signal: a 1–2 step epoch is all warmup, not steady state.
+        ep_steps = consumed - start_consumed
+        wait_frac = loader_wait_s / max(ep_wall, 1e-9)
+        if ep_steps >= 8 and wait_frac > LOADER_WAIT_TRIPWIRE_FRAC:
+            tel.counter("train/loader_wait_tripwire")
+            tel.meta("loader_wait_tripwire", epoch=epoch,
+                     frac=round(wait_frac, 4),
+                     loader_wait_s=round(loader_wait_s, 3),
+                     wall_s=round(ep_wall, 3))
+            if proc0:
+                logger.warning(
+                    "input pipeline not saturated: loader_wait %.1fs is "
+                    "%.0f%% of epoch wall (threshold %.0f%%) — retune with "
+                    "bench.py --mode pipeline --auto-tune",
+                    loader_wait_s, 100 * wait_frac,
+                    100 * LOADER_WAIT_TRIPWIRE_FRAC)
         if proc0:
             # wall + loader-wait on the one-line epoch summary: single-log
             # triage of "slow epoch — device or input pipeline?" without

@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# Pipeline tuner smoke: a tiny synthetic 2x2 sweep (k in {1,2} x workers in
+# {0,2}) through bench.py --mode pipeline --auto-tune, then prove the whole
+# contract end to end:
+#   * every cell reports the loader_wait/dispatch/fetch_stall/assembly_wait
+#     breakdown and the tuner persists the winning cell,
+#   * the --sweep-out JSONL folds into scripts/telemetry_report.py's
+#     "pipeline cell" table,
+#   * the bench output wrapped as a BENCH_r06-shaped artifact passes
+#     scripts/perf_gate.py --check-format,
+#   * train_end2end.py --tuned-pipeline (same config) finds the persisted
+#     cell and boots into it (the "tuned pipeline:" log line).
+set -e
+base=${PIPELINE_SMOKE_DIR:-/tmp/mxr_pipeline_smoke}
+rm -rf "$base"
+mkdir -p "$base"
+export MXR_PROGRAM_CACHE="$base/cache"
+
+# the tiny config shared by the sweep and the tuned boot: the tuned-cell
+# key is a config digest, so both invocations must describe the SAME model
+TINY_CFG=(--cfg "TRAIN__RPN_PRE_NMS_TOP_N=200" \
+          --cfg "TRAIN__RPN_POST_NMS_TOP_N=32" \
+          --cfg "TRAIN__BATCH_ROIS=16" \
+          --cfg "tpu__SCALES=((64,96),)" \
+          --cfg "tpu__MAX_GT=4" \
+          --cfg "network__ANCHOR_SCALES=(2,4)")
+
+python bench.py --mode pipeline --network resnet50 --auto-tune \
+  --k-list 1,2 --workers-list 0,2 --prefetch-list 2 \
+  --pipeline-images 8 --pipeline-epochs 1 \
+  --sweep-out "$base/sweep.jsonl" "${TINY_CFG[@]}" \
+  > "$base/bench_pipeline.json"
+
+test -f "$base/cache/pipeline_tuned.json"
+test -f "$base/sweep.jsonl"
+
+python - "$base" <<'EOF'
+import json, sys
+
+base = sys.argv[1]
+with open(f"{base}/bench_pipeline.json") as f:
+    out = json.load(f)
+pipe = out["pipeline"]
+assert len(pipe["cells"]) == 4, [c["cell"] for c in pipe["cells"]]
+for row in pipe["cells"]:
+    for field in ("imgs_per_sec", "loader_wait_s", "dispatch_s",
+                  "fetch_stall_s", "assembly_wait_s", "loader_wait_frac",
+                  "loader_wait_ok"):
+        assert field in row, (row.get("cell"), field)
+best = max(pipe["cells"], key=lambda r: r["imgs_per_sec"])
+assert pipe["best"]["cell"] == best["cell"]
+assert pipe["tuned"]["k"] == best["k"], (pipe["tuned"], best)
+with open(f"{base}/cache/pipeline_tuned.json") as f:
+    doc = json.load(f)
+assert doc["schema"] == "mxr-pipeline-tuned-v1"
+assert len(doc["tuned"]) == 1
+rows = [json.loads(l) for l in open(f"{base}/sweep.jsonl")]
+assert len(rows) == 4
+assert all(r["kind"] == "meta" and r["name"] == "pipeline_cell"
+           for r in rows)
+print(f"pipeline_smoke: tuner selected {best['cell']} "
+      f"({best['imgs_per_sec']:.2f} imgs/s, "
+      f"loader_wait {100 * best['loader_wait_frac']:.1f}%)")
+EOF
+
+# the sweep JSONL must fold into the report's pipeline table
+python scripts/telemetry_report.py "$base/sweep.jsonl" | tee "$base/report.txt"
+grep -q "pipeline cell" "$base/report.txt"
+
+# BENCH trajectory shape: wrap the bench line like the driver does and
+# format-check it alongside the checked-in trajectory
+python - "$base" <<'EOF'
+import json, sys
+
+base = sys.argv[1]
+with open(f"{base}/bench_pipeline.json") as f:
+    parsed = json.load(f)
+with open(f"{base}/BENCH_r06.json", "w") as f:
+    json.dump({"n": 6, "cmd": "bench.py --mode pipeline (smoke)",
+               "rc": 0, "tail": "", "parsed": parsed}, f, indent=1)
+EOF
+python scripts/perf_gate.py --check-format "$base/BENCH_r06.json"
+
+# tuned boot: the train driver must find the persisted cell for the SAME
+# config and log the tuned (k, workers, prefetch, device_prep) it applied
+python train_end2end.py --network resnet50 --synthetic --synthetic_images 8 \
+  --prefix "$base/ckpt" --end_epoch 1 --num-steps 2 --frequent 1 \
+  --tuned-pipeline "${TINY_CFG[@]}" 2>&1 | tee "$base/train.log"
+grep -q "tuned pipeline: k=" "$base/train.log"
+
+echo "pipeline_smoke: OK"

@@ -21,6 +21,13 @@ asserts on it.  Streams from a serving run (serve.py / bench.py --mode
 serve) additionally get a "serve health" section — requests/batches plus
 the rejection, deadline-exceeded, and post-warmup recompile counters,
 zeros included — which script/serve_smoke.sh asserts on the same way.
+
+Streams carrying ``pipeline_cell`` meta rows — a live run of ``bench.py
+--mode pipeline``, or its ``--sweep-out`` JSONL passed directly as a
+path — get a "pipeline cell" section: one row per sweep cell (fastest
+first) with imgs/s and the loader_wait / assembly_wait / dispatch
+breakdown, so "which knob moved the needle and where did the time go"
+reads off one table; script/pipeline_smoke.sh asserts on it.
 """
 
 import argparse

@@ -30,7 +30,8 @@ from mx_rcnn_tpu.tools.common import (CappedLoader, add_common_args,
                                       check_dist_loader, config_from_args,
                                       get_imdb, get_train_roidb,
                                       init_or_load_params, setup_parallel,
-                                      start_observability)
+                                      start_observability,
+                                      strip_device_prep_for_mesh)
 from mx_rcnn_tpu.train import ResilienceOptions, fit
 
 
@@ -48,6 +49,10 @@ def train_net(args):
     # rendezvous before anything can touch the jax backend
     plan, pidx, pcount = setup_parallel(args)
     cfg = config_from_args(args, train=True)
+    # --device-prep (and a tuned cell that selected it) is single-mesh
+    # only: downgrade BEFORE the loader is built, or it would emit raw
+    # uint8 batches the mesh path cannot prep
+    cfg = strip_device_prep_for_mesh(cfg, plan)
     n_dev = plan.n_data if plan else 1
     batch_size = args.batch_images or n_dev * cfg.TRAIN.BATCH_IMAGES
     if plan and batch_size % n_dev:
