@@ -55,6 +55,13 @@ prints one JSON line):
       the winner next to the program cache so train_end2end.py
       --tuned-pipeline boots into it.  method: "pipeline"
       (loader-inclusive), its own baseline key ("value_pipeline").
+  python bench.py --mode eval            # whole pred_eval loop, three
+      variants one row apart: serial (inflight=0), pipelined (the
+      overlapped evaluator, the headline) and pipelined +
+      --device-postprocess (fused decode+NMS, shrunk readback).  The
+      "eval" sub-dict carries all three rates + speedup_vs_serial,
+      which scripts/perf_gate.py scores against an absolute 1.0 floor.
+      method: "pred_eval", its own baseline key ("value_eval").
   --workers-list/--prefetch-list on --mode loader / train-loader sweep
       the standalone cells in ONE invocation (headline = best, every
       cell in the JSON's "cells" array, metric suffixed _sweep).
@@ -628,12 +635,47 @@ def bench_infer_mask(batch: int, network: str = "resnet101_fpn_mask"):
     return best
 
 
+def bench_eval(batch: int, network: str = "resnet101", num_images: int = 24):
+    """Serial vs pipelined vs --device-postprocess through the REAL
+    ``pred_eval`` loop over the synthetic imdb — the three eval variants
+    one row apart, on the same box, same warm program cache.  Warms every
+    jit shape first (incl. the fused device-postprocess program), then
+    takes best-of-2 per variant, interleaved so drift hits all three
+    equally.  Headline value = pipelined rate; the serial rate is the
+    denominator of ``speedup_vs_serial``, which perf_gate scores against
+    an absolute floor of 1.0 ("the overlap machinery must not lose to
+    the loop it replaced")."""
+    from mx_rcnn_tpu.data.loader import TestLoader
+    from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+    from mx_rcnn_tpu.eval.tester import pred_eval
+
+    pred, cfg = build_infer(batch, network)
+    ds = SyntheticDataset(num_images=num_images, height=600, width=800)
+    roidb = ds.gt_roidb()
+
+    def run(**kw):
+        t0 = time.time()
+        pred_eval(pred, TestLoader(roidb, cfg, batch_size=batch), ds,
+                  with_masks=cfg.network.HAS_MASK, **kw)
+        return len(roidb) / (time.time() - t0)
+
+    run(inflight=2)                             # warm the host-NMS shapes
+    run(inflight=2, device_postprocess=True)    # warm the fused program
+    rates = {"serial": 0.0, "pipelined": 0.0, "device_post": 0.0}
+    for _ in range(2):
+        rates["serial"] = max(rates["serial"], run(inflight=0))
+        rates["pipelined"] = max(rates["pipelined"], run(inflight=2))
+        rates["device_post"] = max(
+            rates["device_post"], run(inflight=2, device_postprocess=True))
+    return rates
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="train",
                     choices=["train", "loader", "train-loader", "infer",
                              "infer-loader", "infer-mask", "serve",
-                             "pipeline"])
+                             "pipeline", "eval"])
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--loader-workers", type=int, default=0,
                     dest="loader_workers",
@@ -729,6 +771,7 @@ def main():
     opt_acc = None
     sweep_cells = None
     pipe = None
+    eval_rates = None
     if args.mode == "train":
         fn = bench_train_staged if args.legacy_dispatch else bench_train_chain
         if args.opt_acc_ab:
@@ -802,6 +845,12 @@ def main():
          serve_warmup_s) = bench_serve(args.batch, args.network)
         metric = "serve_imgs_per_sec"
         infer_method = "engine"  # not comparable to forward-only rows
+    elif args.mode == "eval":
+        eval_rates = bench_eval(args.batch, args.network)
+        value = eval_rates["pipelined"]
+        metric = "eval_imgs_per_sec"
+        infer_method = "pred_eval"  # whole-eval-loop rate: never
+        # comparable to forward-only or loader-only rows
     else:
         value = bench_infer_loader(args.batch, args.network)
         metric = "infer_imgs_per_sec_loader_inclusive"
@@ -879,6 +928,32 @@ def main():
         else:
             vs = round(value / base, 3)
         baseline_method = "pipeline"
+    elif args.mode == "eval":
+        # eval gets its own baseline series per (batch, network): the
+        # number is a whole-pred_eval rate (loader + forward + NMS +
+        # scoring), never comparable to the other series.  The _ab
+        # (--cfg) variants are unscored like everywhere else, but the
+        # speedup_vs_serial floor row still gates them — "pipelined
+        # beats serial" must hold on any config.
+        if not args.cfg:
+            key = "value_eval"
+            if args.batch != 1:
+                key += f"_b{args.batch}"
+            if args.network != "resnet101":
+                key += f"_{args.network}"
+            base_doc = {}
+            if os.path.exists(BASELINE_FILE):
+                with open(BASELINE_FILE) as f:
+                    base_doc = json.load(f)
+            base = base_doc.get(key)
+            if base is None:  # first eval run of this shape: record it
+                base_doc[key] = value
+                with open(BASELINE_FILE, "w") as f:
+                    json.dump(base_doc, f)
+                baseline_recorded = True
+            else:
+                vs = round(value / base, 3)
+            baseline_method = "pred_eval"
 
     out = {
         "metric": metric,
@@ -901,6 +976,18 @@ def main():
         out["warmup_compile_s"] = serve_warmup_s
     if opt_acc is not None:
         out["opt_acc"] = opt_acc
+    if eval_rates is not None:
+        # one row, three variants (satellite contract: serial vs
+        # pipelined vs device-postprocess on the same box); perf_gate
+        # expands speedup_vs_serial into an absolute-floor row
+        out["eval"] = {
+            "serial_imgs_per_sec": round(eval_rates["serial"], 3),
+            "pipelined_imgs_per_sec": round(eval_rates["pipelined"], 3),
+            "device_post_imgs_per_sec": round(eval_rates["device_post"], 3),
+            "speedup_vs_serial": round(
+                eval_rates["pipelined"] / max(eval_rates["serial"], 1e-9),
+                4),
+        }
     if sweep_cells is not None:
         out["cells"] = sweep_cells
     if pipe is not None:

@@ -138,7 +138,11 @@ class ProgramKey:
 
     digest: str          # config_digest(cfg)
     kind: str            # e.g. "predict", "train_step", "masks_packed"
-    shape: Tuple[int, ...]   # full padded input shape (batch leading)
+    # full padded input shape (batch leading), optionally extended with
+    # string tokens for non-shape statics baked into the executable (the
+    # device-postprocess program appends e.g. "mpi=100"/"th=0.001" — two
+    # runs differing only in those flags are different XLA programs)
+    shape: Tuple[Any, ...]
     batch: int           # leading dim, kept explicit for the manifest
     dtype: str           # inference/compute dtype variant
     sharding: str        # plan_signature(plan)
@@ -218,9 +222,12 @@ class ProgramRegistry:
 
     # -- keys + marker manifest -----------------------------------------
 
-    def key_for(self, kind: str, shape: Iterable[int]) -> ProgramKey:
-        shape = tuple(int(s) for s in shape)
-        batch = int(shape[0]) if shape else 0
+    def key_for(self, kind: str, shape: Iterable) -> ProgramKey:
+        # int-like tokens normalize to int (numpy scalars hash/serialize
+        # differently); anything else (static-arg tags) stays a string
+        shape = tuple(s if isinstance(s, str) else int(s) for s in shape)
+        ints = [s for s in shape if not isinstance(s, str)]
+        batch = int(ints[0]) if ints else 0
         return ProgramKey(self.digest, kind, shape, batch, self.dtype,
                           self.sharding)
 

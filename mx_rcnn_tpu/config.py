@@ -241,6 +241,15 @@ class TPUConfig:
     ROI_MODE: str = "avg"
     # host→device prefetch depth
     PREFETCH: int = 2
+    # overlapped eval (eval/pipeline.py): max batches dispatched-but-not-
+    # post-processed; 2 = double-buffering (forward N+1 overlaps host
+    # post-process N); 0 via --eval-inflight falls back to the serial
+    # reference loop
+    EVAL_INFLIGHT: int = 2
+    # width of the eval host post-process thread pool (decode + per-class
+    # NMS + mask paste); results are index-addressed so width never
+    # changes the output
+    EVAL_HOST_WORKERS: int = 2
     # consumer-side watchdog on the prefetch queue: no producer heartbeat
     # for this long raises a diagnostic naming the producer state instead
     # of the training loop hanging forever on a stuck filesystem read

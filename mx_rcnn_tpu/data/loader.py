@@ -537,7 +537,8 @@ class TestLoader:
 
     __test__ = False  # not a pytest class
 
-    def __init__(self, roidb: list, cfg: Config, batch_size: int = 1):
+    def __init__(self, roidb: list, cfg: Config, batch_size: int = 1,
+                 prefetch: Optional[int] = None):
         self.roidb = roidb
         if getattr(cfg.tpu, "DEVICE_PREP", False):
             # device prep is a TRAIN-path feature; eval stays on the
@@ -548,6 +549,11 @@ class TestLoader:
                                                    DEVICE_PREP=False))
         self.cfg = cfg
         self.batch_size = batch_size
+        # prefetch depth override: the overlapped evaluator keeps more
+        # batches in flight than the train default assumes, so the decode
+        # pipeline must stay ahead of the wider dispatch window
+        self.prefetch = (int(prefetch) if prefetch is not None
+                         else cfg.tpu.PREFETCH)
         # double-buffering hook (Predictor.batch_put): transfers the
         # device-bound keys from the prefetch thread, keeps indices/
         # batch_valid host-side
@@ -575,7 +581,7 @@ class TestLoader:
         # strict loads by design (no fault isolation): a silently
         # substituted record would corrupt the eval metric
         return iter(_Prefetcher(
-            produce(), self.cfg.tpu.PREFETCH, put=self.put,
+            produce(), self.prefetch, put=self.put,
             watchdog_s=self.cfg.tpu.PREFETCH_WATCHDOG_S))
 
 

@@ -25,7 +25,9 @@ from mx_rcnn_tpu.compile.registry import INFER_DTYPES
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.loader import TestLoader
 from mx_rcnn_tpu.logger import logger
-from mx_rcnn_tpu.ops.postprocess import decode_image_boxes, per_class_nms
+from mx_rcnn_tpu.ops.postprocess import (decode_image_boxes,
+                                         device_dets_to_per_class,
+                                         per_class_nms)
 
 
 def _variant_params(params, dtype: str):
@@ -217,6 +219,47 @@ class Predictor:
 
             reg.register("masks_packed", build_packed)
 
+        # fused forward + decode + per-class NMS ("--device-postprocess"):
+        # the host reads back (B, cap, 6) final detections instead of the
+        # full (R, K) scores + (R, 4K) deltas.  The statics
+        # (max_per_image, thresh) are baked into the executable, so
+        # predict_detections folds them into the registry shape key too —
+        # two evals differing only in those flags are different programs.
+        has_mask = self._has_mask
+
+        def build_post(max_per_image, thresh):
+            import jax.numpy as jnp
+
+            from mx_rcnn_tpu.ops.postprocess import device_postprocess
+
+            def f(p, images, im_info):
+                if has_mask:
+                    out, feats = model.apply({"params": unpack(p)}, images,
+                                             im_info,
+                                             method=model.predict_with_feats)
+                else:
+                    out = model.apply({"params": unpack(p)}, images, im_info,
+                                      method=model.predict)
+                    feats = None
+                # cast BEFORE the decode: low-precision variants must not
+                # run the box math (or NMS IoUs) in bf16 — parity with the
+                # host path is pinned per-dtype by the f32 cast here
+                rois, roi_valid, cls_prob, bbox_deltas = cast_out(
+                    out[:4])
+                dets, dvalid = device_postprocess(
+                    rois, roi_valid, cls_prob, bbox_deltas,
+                    jnp.asarray(im_info, jnp.float32),
+                    num_classes=cfg.NUM_CLASSES, thresh=thresh,
+                    nms_thresh=cfg.TEST.NMS, max_per_image=max_per_image,
+                    use_pallas=cfg.TEST.CXX_PROPOSAL)
+                if has_mask:
+                    return (dets, dvalid), feats
+                return dets, dvalid
+
+            return jit2(f)
+
+        reg.register("predict_post", build_post)
+
     def batch_put(self, batch: dict) -> dict:
         """The TestLoader ``put`` hook: move ``images`` (the only large
         buffer) onto the mesh (or chip) from the prefetch thread so the
@@ -287,12 +330,43 @@ class Predictor:
                               self.registry.lookup("predict"),
                               images, im_info)
 
+    def predict_detections(self, images, im_info, max_per_image, thresh):
+        """Fused forward + device post-process (``--device-postprocess``):
+        → ((B, cap, 6) [x1..y2,score,cls] dets, (B, cap) valid), both still
+        on device.  Readback is ``max_per_image`` rows per image instead of
+        the full (R, K) scores + (R, 4K) deltas.  On mask configs the
+        pyramid is cached exactly like ``predict`` (same token
+        discipline)."""
+        mpi = int(max_per_image)
+        th = float(thresh)
+        self._predict_count += 1
+        self._feats_token = (tuple(images.shape), self._predict_count)
+        fn = self.registry.lookup("predict_post", static=(mpi, th))
+        # string tokens carry the baked-in statics into the program key —
+        # a different cap/threshold is a different executable
+        shape = tuple(images.shape) + (f"mpi={mpi}", f"th={th:g}")
+        if self._has_mask:
+            (dets, dvalid), feats = self._dispatch(
+                "predict_post", shape, fn, images, im_info)
+            self._feats = feats
+            return dets, dvalid
+        return self._dispatch("predict_post", shape, fn, images, im_info)
+
     @property
     def feats_token(self):
         """Identity of the batch whose pyramid is cached — capture right
         after ``predict`` and hand to the ``predict_masks_*`` cached entry
         points to pin them to that batch."""
         return self._feats_token
+
+    def capture_feats(self):
+        """Overlap-safe handle on the pyramid the last ``predict`` cached:
+        ``(feats, token)``.  The pipelined evaluator calls this right after
+        dispatching batch N's forward, BEFORE dispatching batch N+1 — the
+        captured pair stays valid after the cache is overwritten, so the
+        mask pass for N can run while N+1 is in flight (pass ``feats=`` to
+        the ``predict_masks_*`` entry points)."""
+        return self._feats, self._feats_token
 
     def _check_token(self, token):
         if token != self._feats_token:
@@ -317,32 +391,43 @@ class Predictor:
                               self.registry.lookup("masks_from_feats"),
                               feats, boxes, labels)
 
-    def predict_masks_cached(self, boxes, labels, token):
+    def predict_masks_cached(self, boxes, labels, token, feats=None):
         """Mask branch over the pyramid cached by the immediately preceding
         ``predict`` — ONLY valid for that same batch.  ``token`` (required:
         capture :attr:`feats_token` right after the ``predict`` call) pins
-        the call to its batch; a reordered caller fails loudly."""
+        the call to its batch; a reordered caller fails loudly.  An
+        explicitly passed ``feats`` (from :meth:`capture_feats`) bypasses
+        the cache AND the token check — the captured pair already
+        identifies its batch, which is what makes the pipelined
+        evaluator's overlapped mask pass safe."""
         assert self._has_mask, "model has no mask head"
-        assert self._feats is not None, "call predict() on this batch first"
-        self._check_token(token)
+        if feats is None:
+            assert self._feats is not None, \
+                "call predict() on this batch first"
+            self._check_token(token)
+            feats = self._feats
         return self._dispatch("masks_from_feats", boxes.shape,
                               self.registry.lookup("masks_from_feats"),
-                              self._feats, boxes, labels)
+                              feats, boxes, labels)
 
     def predict_masks_packed(self, boxes, labels, orig_boxes, hp, wp,
-                             token):
+                             token, feats=None):
         """Mask branch + on-device paste over the cached pyramid: SCALED-
         frame ``boxes`` feed RoIAlign, ORIGINAL-frame ``orig_boxes`` place
         the masks in the padded (hp, wp) original frame.  One fused jit
         call → (B, R, wp, hp//8) packed bitplanes; the host's only work is
-        the C++ RLE encode (``native.rle_encode_packed``)."""
+        the C++ RLE encode (``native.rle_encode_packed``).  ``feats``
+        semantics as in :meth:`predict_masks_cached`."""
         assert self._has_mask, "model has no mask head"
-        assert self._feats is not None, "call predict() on this batch first"
-        self._check_token(token)
+        if feats is None:
+            assert self._feats is not None, \
+                "call predict() on this batch first"
+            self._check_token(token)
+            feats = self._feats
         fn = self.registry.lookup("masks_packed", static=(hp, wp))
         return self._dispatch("masks_packed",
                               tuple(boxes.shape) + (hp, wp), fn,
-                              self._feats, boxes, labels, orig_boxes)
+                              feats, boxes, labels, orig_boxes)
 
     def _pyramid(self, images):
         return self._dispatch("pyramid", images.shape,
@@ -407,12 +492,73 @@ def im_detect(predictor: Predictor, batch: dict):
     return out
 
 
+def _im_detect_device(predictor, batch, max_per_image, thresh, num_classes):
+    """``im_detect`` + ``per_class_nms`` fused on device
+    (``--device-postprocess``): forward one batch through the
+    ``predict_post`` program and read back only the top-``max_per_image``
+    detections per image.  Returns a list of per-class detection lists
+    (the ``per_class_nms`` shape), one per valid batch row — so the caller
+    fills ``all_boxes`` identically on either path."""
+    tel = telemetry.get()
+    with tel.span("eval/forward"):
+        dets, dvalid = predictor.predict_detections(
+            batch["images"], batch["im_info"], max_per_image, thresh)
+    with tel.span("eval/readback"):
+        dets, dvalid = jax.device_get((dets, dvalid))
+    n = int(np.sum(batch.get("batch_valid", np.ones(len(dets), bool))))
+    out = []
+    with tel.span("eval/decode"):
+        for b in range(n):
+            out.append(device_dets_to_per_class(dets[b], dvalid[b],
+                                                num_classes))
+    return out
+
+
+class _Progress:
+    """Monotonic eval progress reporter.  The old inline check
+    (``done % 100 < len(dets)``) could fire several batches in a row or
+    skip a century entirely depending on how the batch size strides the
+    modulus; this keeps an explicit next-threshold, so exactly one line
+    (and one rate gauge) is emitted per ``every`` images regardless of
+    batch size or completion order."""
+
+    def __init__(self, total: int, n_chips: int, every: int = 100):
+        self.total = total
+        self.n_chips = max(int(n_chips), 1)
+        self.every = max(int(every), 1)
+        self._next = self.every
+        self.t0 = time.perf_counter()
+
+    def update(self, done: int, tel) -> None:
+        if done < self._next:
+            return
+        self._next = (done // self.every + 1) * self.every
+        rate = max(done, 1) / max(time.perf_counter() - self.t0, 1e-9)
+        tel.gauge("eval/imgs_per_sec", rate)
+        logger.info("im_detect: %d/%d  %.3fs/im  %.1f imgs/s (%.1f/chip)",
+                    done, self.total, 1.0 / rate, rate, rate / self.n_chips)
+
+
+def save_vis(rec: dict, all_boxes, num_classes: int, class_names,
+             i: int) -> None:
+    """Write one image's detection visualization under ``vis/`` — shared
+    by the serial loop and the pipelined host tasks."""
+    vis_dir = "vis"
+    os.makedirs(vis_dir, exist_ok=True)
+    vis_all_detection(
+        rec, [all_boxes[k][i] if k else None for k in range(num_classes)],
+        class_names, os.path.join(vis_dir, f"{i:06d}.jpg"))
+
+
 def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
               max_per_image: Optional[int] = None,
               thresh: Optional[float] = None,
               vis: bool = False,
               with_masks: bool = False,
-              det_cache: Optional[str] = None) -> dict:
+              det_cache: Optional[str] = None,
+              inflight: Optional[int] = None,
+              host_workers: Optional[int] = None,
+              device_postprocess: bool = False) -> dict:
     """Dataset eval loop (reference ``pred_eval``): all_boxes[cls][image] =
     (N, 5) [x1,y1,x2,y2,score]; per-class score threshold + NMS; global
     per-image cap; then ``imdb.evaluate_detections``.
@@ -425,10 +571,26 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
     writes ``detections.pkl`` into the imdb cache; ``tools/reeval.py``
     re-scores it without a model or device).
 
+    ``inflight`` (default ``cfg.tpu.EVAL_INFLIGHT``): dispatch window of
+    the overlapped evaluator (``eval/pipeline.py``) — batch N+1's forward
+    runs on device while batch N decodes/NMSes on a ``host_workers``-wide
+    thread pool.  Results are index-addressed, so ``all_boxes`` (and the
+    det_cache / scoring downstream) is bit-identical to the serial loop at
+    any depth.  ``inflight=0`` forces the serial reference loop — the
+    oracle the identity test pins the pipeline against.
+
+    ``device_postprocess``: route the fused forward+decode+NMS program
+    (``Predictor.predict_detections``) so the host reads back only the
+    top-``max_per_image`` detections per image instead of the full
+    (R, K) + (R, 4K) tensors.  Opt-in: exact score ties at thresholds may
+    resolve differently from the host path (see
+    ``ops.postprocess.device_postprocess``).
+
     Phase telemetry (whatever sink is active — ``mx_rcnn_tpu/telemetry``):
     per-batch ``eval/loader_wait`` / ``eval/forward`` / ``eval/readback``
     / ``eval/decode`` / ``eval/nms`` (+ ``eval/mask_pass``) spans, an
-    ``eval/imgs_per_sec`` gauge and an ``eval/images`` counter — the same
+    ``eval/imgs_per_sec`` gauge, an ``eval/images`` counter and one
+    ``eval_pipeline`` meta record with the overlap breakdown — the same
     JSONL schema as the train stream, so one report folds both.
     """
     cfg = predictor.cfg
@@ -436,6 +598,11 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
         max_per_image = cfg.TEST.MAX_PER_IMAGE
     if thresh is None:
         thresh = cfg.TEST.THRESH
+    tpu_cfg = getattr(cfg, "tpu", None)
+    if inflight is None:
+        inflight = int(getattr(tpu_cfg, "EVAL_INFLIGHT", 2))
+    if host_workers is None:
+        host_workers = int(getattr(tpu_cfg, "EVAL_HOST_WORKERS", 2))
     num_classes = imdb.num_classes
     num_images = imdb.num_images
     with_masks = with_masks and cfg.network.HAS_MASK
@@ -443,6 +610,10 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
         logger.warning("%s has no segm evaluation; scoring boxes only",
                        type(imdb).__name__)
         with_masks = False
+    if device_postprocess and not hasattr(predictor, "predict_detections"):
+        logger.warning("--device-postprocess needs a Predictor with "
+                       "predict_detections; falling back to host NMS")
+        device_postprocess = False
 
     if det_cache:
         # fail on an unwritable path BEFORE the inference loop, not after
@@ -471,51 +642,89 @@ def pred_eval(predictor: Predictor, test_loader: TestLoader, imdb,
         [[None for _ in range(num_images)] for _ in range(num_classes)]
         if with_masks else None)
     tel = telemetry.get()
-    t0 = time.perf_counter()
-    done = 0
-    it = iter(test_loader)
-    while True:
-        t_wait = time.perf_counter()
-        try:
-            batch = next(it)
-        except StopIteration:
-            break
-        tel.add("eval/loader_wait", time.perf_counter() - t_wait)
-        dets = im_detect(predictor, batch)
-        # the pyramid predict() just cached belongs to THIS batch; the
-        # token pins the mask pass to it (stale-cache guard)
-        tok = getattr(predictor, "feats_token", None)
-        indices = batch["indices"]
-        t_nms = time.perf_counter()
-        for b, (scores, boxes, valid) in enumerate(dets):
-            i = int(indices[b])
-            # shared post-process path (ops/postprocess.py) — the serve
-            # engine runs the identical block, pinned by a parity test
-            dets_pc = per_class_nms(scores, boxes, valid, num_classes,
-                                    thresh, cfg.TEST.NMS, max_per_image)
-            for k in range(1, num_classes):
-                all_boxes[k][i] = dets_pc[k]
-            if vis:
-                vis_dir = "vis"
-                os.makedirs(vis_dir, exist_ok=True)
-                vis_all_detection(
-                    test_loader.roidb[i],
-                    [all_boxes[k][i] if k else None
-                     for k in range(num_classes)],
-                    imdb.classes, os.path.join(vis_dir, f"{i:06d}.jpg"))
-            done += 1
-        tel.add("eval/nms", time.perf_counter() - t_nms, n=len(dets))
-        if with_masks:
-            with tel.span("eval/mask_pass"):
-                _mask_pass(predictor, batch, dets, all_boxes, all_masks,
-                           test_loader.roidb, max_per_image, num_classes,
-                           token=tok)
-        if done % 100 < len(dets):
-            rate = max(done, 1) / (time.perf_counter() - t0)
-            tel.gauge("eval/imgs_per_sec", rate)
-            logger.info("im_detect: %d/%d  %.3fs/im  %.1f imgs/s (%.1f/chip)",
-                        done, num_images, 1.0 / rate, rate, rate / n_chips)
+    progress = _Progress(num_images, n_chips)
+    stats = {}
+    if inflight and int(inflight) > 0:
+        from mx_rcnn_tpu.eval.pipeline import run_pipelined
+        stats = run_pipelined(
+            predictor, test_loader, all_boxes=all_boxes,
+            all_masks=all_masks, imdb=imdb, num_classes=num_classes,
+            max_per_image=max_per_image, thresh=thresh,
+            nms_thresh=cfg.TEST.NMS, vis=vis, with_masks=with_masks,
+            device_postprocess=device_postprocess, inflight=int(inflight),
+            host_workers=int(host_workers), progress=progress)
+        done = stats["images"]
+        loader_wait = stats["loader_wait_s"]
+        mode = stats["mode"]
+    else:
+        mode = "serial+devpost" if device_postprocess else "serial"
+        done = 0
+        loader_wait = 0.0
+        it = iter(test_loader)
+        while True:
+            t_wait = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            dt_wait = time.perf_counter() - t_wait
+            loader_wait += dt_wait
+            tel.add("eval/loader_wait", dt_wait)
+            if device_postprocess:
+                dets = _im_detect_device(predictor, batch, max_per_image,
+                                         thresh, num_classes)
+            else:
+                dets = im_detect(predictor, batch)
+            # the pyramid predict() just cached belongs to THIS batch; the
+            # token pins the mask pass to it (stale-cache guard)
+            tok = getattr(predictor, "feats_token", None)
+            indices = batch["indices"]
+            t_nms = time.perf_counter()
+            for b, row in enumerate(dets):
+                i = int(indices[b])
+                if device_postprocess:
+                    dets_pc = row  # already per-class from the device
+                else:
+                    scores, boxes, valid = row
+                    # shared post-process path (ops/postprocess.py) — the
+                    # serve engine runs the identical block, pinned by a
+                    # parity test
+                    dets_pc = per_class_nms(scores, boxes, valid,
+                                            num_classes, thresh,
+                                            cfg.TEST.NMS, max_per_image)
+                for k in range(1, num_classes):
+                    all_boxes[k][i] = dets_pc[k]
+                if vis:
+                    save_vis(test_loader.roidb[i], all_boxes, num_classes,
+                             imdb.classes, i)
+                done += 1
+            tel.add("eval/nms", time.perf_counter() - t_nms, n=len(dets))
+            if with_masks:
+                with tel.span("eval/mask_pass"):
+                    _mask_pass(predictor, batch, dets, all_boxes, all_masks,
+                               test_loader.roidb, max_per_image, num_classes,
+                               token=tok)
+            progress.update(done, tel)
+    wall = time.perf_counter() - progress.t0
+    rate = done / max(wall, 1e-9)
+    tel.gauge("eval/imgs_per_sec", rate)
     tel.counter("eval/images", done)
+    host_post = stats.get("host_post_s", 0.0)
+    post_wait = stats.get("post_wait_s", 0.0)
+    overlap = (max(0.0, 1.0 - post_wait / host_post)
+               if host_post > 0 else 0.0)
+    tel.meta("eval_pipeline", mode=mode, images=done,
+             imgs_per_sec=round(rate, 3), wall_s=round(wall, 3),
+             loader_wait_s=round(loader_wait, 3),
+             readback_wait_s=round(stats.get("readback_wait_s", 0.0), 3),
+             host_post_s=round(host_post, 3),
+             post_wait_s=round(post_wait, 3),
+             overlap_frac=round(overlap, 4),
+             inflight=int(inflight), host_workers=int(host_workers),
+             device_postprocess=bool(device_postprocess))
+    logger.info("pred_eval[%s]: %d images  Wall=%.1fs  LoaderWait=%.1fs  "
+                "%.1f imgs/s (%.1f/chip)", mode, done, wall, loader_wait,
+                rate, rate / n_chips)
     if det_cache:
         # write-then-rename so det_cache is only ever complete or absent;
         # pid-suffixed tmp so concurrent evals can't interleave, unlinked
@@ -570,7 +779,7 @@ def _round_up(x: int, mult: int) -> int:
 
 
 def _mask_pass(predictor, batch, dets, all_boxes, all_masks, roidb,
-               max_per_image, num_classes, token=None):
+               max_per_image, num_classes, token=None, feats=None):
     """Run the mask branch for one batch's FINAL detections and fill
     ``all_masks`` with full-image RLEs aligned row-for-row with
     ``all_boxes``.
@@ -588,6 +797,11 @@ def _mask_pass(predictor, batch, dets, all_boxes, all_masks, roidb,
 
     if not dets:
         return
+    # the feats kwarg is only forwarded when a captured pyramid was
+    # actually handed over: duck-typed test predictors predate it
+    mask_kw = {"token": token}
+    if feats is not None:
+        mask_kw["feats"] = feats
     im_info = np.asarray(batch["im_info"])
     indices = batch["indices"]
     B = batch["images"].shape[0]  # full (padded) batch; dets covers valid rows
@@ -634,14 +848,14 @@ def _mask_pass(predictor, batch, dets, all_boxes, all_masks, roidb,
                 mlabels[b, r] = k
         if use_device:
             packed = np.asarray(jax.device_get(predictor.predict_masks_packed(
-                mboxes, mlabels, morig, hp, wp, token=token)))
+                mboxes, mlabels, morig, hp, wp, **mask_kw)))
 
             def rle_for(b, r, box, h, w):
                 return {"size": [h, w],
                         "counts": rle_encode_packed(packed[b, r], h, w)}
         else:
             probs = np.asarray(jax.device_get(
-                predictor.predict_masks_cached(mboxes, mlabels, token=token)),
+                predictor.predict_masks_cached(mboxes, mlabels, **mask_kw)),
                 np.float32)
 
             def rle_for(b, r, box, h, w):

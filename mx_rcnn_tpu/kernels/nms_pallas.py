@@ -33,10 +33,14 @@ after the shift):
   order (sorted input), so once ``max_out`` boxes are kept the remaining
   blocks are predicated off (kept count in SMEM scratch).
 
-Boxes must arrive score-sorted (the ``propose`` contract — jax.lax.top_k
-upstream).  Same greedy tie/threshold semantics as ``ops.nms.nms_padded``
-(suppress when IoU > thresh, legacy +1 areas), which remains the oracle in
-tests (tests/test_nms.py) and on-chip (scripts/check_pallas.py).
+Boxes must arrive score-sorted.  Two callers honor that contract: RPN
+``propose`` (jax.lax.top_k upstream) and the fused eval post-process
+(``ops.nms.nms_ranked`` argsorts per class before delegating here — the
+``--device-postprocess`` readback-shrink path, where per-class NMS runs
+inside the ``predict_post`` program instead of on the host).  Same greedy
+tie/threshold semantics as ``ops.nms.nms_padded`` (suppress when IoU >
+thresh, legacy +1 areas), which remains the oracle in tests
+(tests/test_nms.py) and on-chip (scripts/check_pallas.py).
 """
 
 from __future__ import annotations

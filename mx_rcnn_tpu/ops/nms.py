@@ -81,6 +81,51 @@ def nms_padded(
     return keep_idx, keep_mask
 
 
+def nms_ranked(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    max_out: int,
+    iou_thresh: float,
+    valid: jnp.ndarray | None = None,
+    use_pallas: bool = False,
+):
+    """Greedy NMS over UNSORTED candidates → score-ranked padded detections.
+
+    The building block of the fused device post-process (one per-class NMS
+    per image inside the ``predict_post`` program): sorts descending by
+    score (invalid rows sink below every real candidate, satisfying the
+    Pallas kernel's score-sorted contract), runs the padded greedy kernel,
+    and gathers the kept rows.
+
+    Returns:
+      dets: (max_out, 5) float32 [x1,y1,x2,y2,score], score-descending —
+        the same row order the host loop's argsort-then-suppress produces;
+        padded slots are zeroed.
+      keep_mask: (max_out,) bool.
+
+    ``use_pallas`` (static) routes through ``kernels.nms_pallas`` — the
+    blocked-bitmask TPU kernel, which itself falls back to ``nms_padded``
+    on non-TPU backends, so CPU tests exercise this exact code path.
+    """
+    s = scores.astype(jnp.float32)
+    if valid is not None:
+        s = jnp.where(valid, s, _NEG)
+    order = jnp.argsort(-s)
+    bs = boxes[order].astype(jnp.float32)
+    ss = s[order]
+    sv = ss > _NEG / 2
+    if use_pallas:
+        from mx_rcnn_tpu.kernels.nms_pallas import nms_pallas
+
+        keep_idx, keep_mask = nms_pallas(bs, ss, max_out=max_out,
+                                         iou_thresh=iou_thresh, valid=sv)
+    else:
+        keep_idx, keep_mask = nms_padded(bs, ss, max_out=max_out,
+                                         iou_thresh=iou_thresh, valid=sv)
+    dets = jnp.concatenate([bs[keep_idx], ss[keep_idx][:, None]], axis=1)
+    return jnp.where(keep_mask[:, None], dets, 0.0), keep_mask
+
+
 def nms(dets: np.ndarray, thresh: float) -> list:
     """Host numpy greedy NMS over (N, 5) [x1,y1,x2,y2,score] rows.
 

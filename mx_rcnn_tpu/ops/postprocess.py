@@ -67,6 +67,84 @@ def per_class_nms(scores: np.ndarray, boxes: np.ndarray, valid,
     return dets
 
 
+def device_postprocess(rois, roi_valid, cls_prob, bbox_deltas, im_info, *,
+                       num_classes: int, thresh: float, nms_thresh: float,
+                       max_per_image: int, per_class_max: Optional[int] = None,
+                       use_pallas: bool = False):
+    """The jit-traceable fusion of :func:`decode_image_boxes` +
+    :func:`per_class_nms` — the ``--device-postprocess`` readback shrink.
+
+    Runs inside the ``predict_post`` program right after the forward, so
+    the host reads back ``(B, cap, 6)`` final detections instead of the
+    full ``(R, K)`` scores + ``(R, 4K)`` deltas.  Per image: decode + clip
+    to the scaled frame, map to ORIGINAL coordinates, per-class score
+    threshold → greedy NMS (``ops.nms.nms_ranked``; ``use_pallas`` routes
+    the TPU bitmask kernel), then the global top-``max_per_image`` cap
+    over all classes.
+
+    Semantics match the host path with one documented exception: the host
+    cap keeps every detection tied AT the cut-off score (``>= th`` can
+    exceed ``max_per_image``), while ``lax.top_k`` keeps exactly
+    ``max_per_image`` rows — exact score ties at the cap boundary may
+    differ.  The parity test pins everything else.
+
+    Returns:
+      dets: (B, cap, 6) float32 [x1,y1,x2,y2,score,cls], score-descending;
+        padded rows zeroed.
+      valid: (B, cap) bool.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    NEG = -1e10
+    R = rois.shape[1]
+    K = num_classes
+    pcm = per_class_max or (max_per_image if max_per_image > 0 else R)
+    cap = max_per_image if max_per_image > 0 else (K - 1) * pcm
+    cap = min(cap, (K - 1) * pcm)
+
+    def one_image(rois_i, valid_i, scores_i, deltas_i, info_i):
+        from mx_rcnn_tpu.ops.nms import nms_ranked
+
+        boxes = decode_boxes(rois_i, deltas_i)
+        boxes = clip_boxes(boxes, info_i[0], info_i[1]) / info_i[2]
+        boxes_k = boxes.reshape(R, K, 4).transpose(1, 0, 2)[1:]  # (K-1, R, 4)
+        scores_k = scores_i.T[1:]                                # (K-1, R)
+        sel_k = (scores_k > thresh) & valid_i[None, :].astype(bool)
+        dets_k, mask_k = jax.vmap(
+            lambda b, s, v: nms_ranked(b, s, pcm, nms_thresh, valid=v,
+                                       use_pallas=use_pallas))(
+            boxes_k, scores_k, sel_k)            # (K-1, pcm, 5) / (K-1, pcm)
+        flat = dets_k.reshape(-1, 5)
+        fscore = jnp.where(mask_k.reshape(-1), flat[:, 4], NEG)
+        top_s, top_i = jax.lax.top_k(fscore, cap)
+        cls = (top_i // pcm + 1).astype(jnp.float32)
+        out = jnp.concatenate([flat[top_i], cls[:, None]], axis=1)
+        dvalid = top_s > NEG / 2
+        return jnp.where(dvalid[:, None], out, 0.0), dvalid
+
+    return jax.vmap(one_image)(rois, roi_valid, cls_prob, bbox_deltas,
+                               im_info)
+
+
+def device_dets_to_per_class(dets: np.ndarray, valid,
+                             num_classes: int) -> List[Optional[np.ndarray]]:
+    """One image's :func:`device_postprocess` readback → the per-class
+    ``[None, (N1,5), ...]`` shape :func:`per_class_nms` returns, so
+    ``all_boxes`` filling (and everything downstream — mask pass, vis,
+    det_cache, scoring) is path-agnostic.  Rows arrive score-descending
+    from the device top-k, which is exactly the host NMS keep order
+    within a class."""
+    dets = np.asarray(dets, np.float32)
+    v = np.asarray(valid, bool)
+    rows = dets[v]
+    out: List[Optional[np.ndarray]] = [None] * num_classes
+    for k in range(1, num_classes):
+        out[k] = np.ascontiguousarray(rows[rows[:, 5] == k][:, :5],
+                                      np.float32)
+    return out
+
+
 def detections_to_records(dets_per_class) -> List[dict]:
     """Per-class (N, 5) arrays → flat JSON-serializable records sorted by
     descending score — the serve response payload shape."""

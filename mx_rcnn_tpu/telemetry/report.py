@@ -97,6 +97,7 @@ def aggregate(events: Iterable[dict]) -> dict:
     ranks = set()
     meta: dict = {}
     pipeline: list = []
+    eval_pipeline: list = []
     for e in events:
         kind = e.get("kind")
         name = e.get("name")
@@ -138,7 +139,13 @@ def aggregate(events: Iterable[dict]) -> dict:
                 # also the shape bench.py --mode pipeline writes to its
                 # --sweep-out JSONL, so that artifact folds here too)
                 pipeline.append(dict(e.get("fields", {})))
+            elif name == "eval_pipeline":
+                # one row per pred_eval run (eval/pipeline.py overlap
+                # breakdown: device-busy vs host post-process vs idle)
+                eval_pipeline.append(dict(e.get("fields", {})))
     out_extra = {"pipeline": pipeline} if pipeline else {}
+    if eval_pipeline:
+        out_extra["eval_pipeline"] = eval_pipeline
     return {
         "schema": SCHEMA_VERSION,
         "ranks": sorted(ranks),
@@ -225,6 +232,25 @@ def render_table(summary: dict) -> str:
                 f"{row.get('assembly_wait_s') or 0.0:>11.3f}"
                 f"{row.get('dispatch_s') or 0.0:>11.3f}"
                 f"{100 * (row.get('loader_wait_frac') or 0.0):>7.1f}%")
+    eval_pipeline = summary.get("eval_pipeline", [])
+    if eval_pipeline:
+        # one row per pred_eval run: how much host post-process time hid
+        # under the device forward (overlap%), and where the main thread
+        # actually waited (loader / readback / host tail)
+        lines.append("")
+        lines.append(f"{'eval pipeline':<20}{'imgs/s':>10}{'wall_s':>9}"
+                     f"{'loader_s':>10}{'readbk_s':>10}{'post_s':>9}"
+                     f"{'overlap%':>9}")
+        for row in sorted(eval_pipeline,
+                          key=lambda r: -(r.get("imgs_per_sec") or 0.0)):
+            lines.append(
+                f"{row.get('mode', '?'):<20}"
+                f"{row.get('imgs_per_sec') or 0.0:>10.3f}"
+                f"{row.get('wall_s') or 0.0:>9.2f}"
+                f"{row.get('loader_wait_s') or 0.0:>10.3f}"
+                f"{row.get('readback_wait_s') or 0.0:>10.3f}"
+                f"{row.get('host_post_s') or 0.0:>9.3f}"
+                f"{100 * (row.get('overlap_frac') or 0.0):>8.1f}%")
     hists = summary.get("hists", {})
     if hists:
         lines.append("")

@@ -59,6 +59,10 @@ STARTUP_ABS_SLACK_S = 2.0
 # router/supervisor overhead (or accidental serialization) is eating
 # the replication win
 REPLICA_LINEARITY_FLOOR = 0.85
+# overlapped-eval floor: the pipelined pred_eval must at least match the
+# serial loop on the same box (speedup ratio >= 1.0) — a pipeline that
+# loses to serial means the overlap machinery is pure overhead
+EVAL_SPEEDUP_FLOOR = 1.0
 
 
 def slo_report_rows(doc: dict) -> list:
@@ -133,10 +137,14 @@ def load_rows(path: str) -> list:
 
 
 def startup_rows(rows: list) -> list:
-    """Expand a serve-bench row's ``cold_start_s`` / ``warmup_compile_s``
-    fields into lower-is-better rows of their own, so the AOT warm-start
-    win is gated exactly like a latency metric: a run that regresses to
-    cold-compiling at boot fails, not just one that serves slowly."""
+    """Expand a bench row's auxiliary fields into rows of their own:
+    ``cold_start_s`` / ``warmup_compile_s`` (serve bench) become
+    lower-is-better rows, so the AOT warm-start win is gated exactly like
+    a latency metric — a run that regresses to cold-compiling at boot
+    fails, not just one that serves slowly; an ``eval`` sub-dict
+    (bench.py --mode eval) contributes a ``speedup_vs_serial`` FLOOR row
+    scored on the newest run alone — "pipelined beats serial on the same
+    box" is a property of the build, not a trend."""
     out = list(rows)
     for row in rows:
         for field in ("cold_start_s", "warmup_compile_s"):
@@ -145,6 +153,14 @@ def startup_rows(rows: list) -> list:
                 out.append({"metric": f"{row.get('metric', '?')}_{field}",
                             "value": v, "unit": "s", "direction": "down",
                             "abs_slack": STARTUP_ABS_SLACK_S})
+        ev = row.get("eval")
+        if isinstance(ev, dict):
+            sp = ev.get("speedup_vs_serial")
+            if isinstance(sp, (int, float)):
+                out.append({"metric": "eval_pipeline_speedup",
+                            "value": round(float(sp), 4), "unit": "ratio",
+                            "floor": ev.get("speedup_floor",
+                                            EVAL_SPEEDUP_FLOOR)})
     return out
 
 

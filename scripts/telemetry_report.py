@@ -28,6 +28,14 @@ path — get a "pipeline cell" section: one row per sweep cell (fastest
 first) with imgs/s and the loader_wait / assembly_wait / dispatch
 breakdown, so "which knob moved the needle and where did the time go"
 reads off one table; script/pipeline_smoke.sh asserts on it.
+
+Streams carrying ``eval_pipeline`` meta rows (any ``pred_eval`` run —
+test.py, bench.py --mode eval, script/eval_smoke.sh) get an "eval
+pipeline" section: one row per eval run with imgs/s, wall time, the
+loader / readback / host-post-process wait split and the overlap
+fraction (how much host post-process hid under the device forward), so
+serial-vs-pipelined-vs-device-postprocess comparisons read off one
+table.
 """
 
 import argparse

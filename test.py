@@ -28,6 +28,22 @@ def parse_args():
     parser.add_argument("--dets_cache", default="",
                         help="pickle all_boxes here for tools/reeval.py "
                              "(the reference's detections.pkl)")
+    parser.add_argument("--eval-inflight", type=int, default=None,
+                        help="overlapped-eval dispatch window (default "
+                             "cfg.tpu.EVAL_INFLIGHT=2); 0 forces the "
+                             "serial reference loop")
+    parser.add_argument("--eval-host-workers", type=int, default=None,
+                        help="host post-process thread-pool width "
+                             "(default cfg.tpu.EVAL_HOST_WORKERS=2)")
+    parser.add_argument("--prefetch", type=int, default=None,
+                        help="TestLoader prefetch depth override "
+                             "(default cfg.tpu.PREFETCH)")
+    parser.add_argument("--device-postprocess", action="store_true",
+                        help="fuse box decode + per-class NMS into the "
+                             "forward program and read back only "
+                             "max_per_image detections per image (opt-in: "
+                             "exact score ties at the cap may resolve "
+                             "differently from host NMS)")
     return parser.parse_args()
 
 
@@ -60,10 +76,14 @@ def test_rcnn(args):
                                         "batch_size": bs},
                               configure_telemetry=True)
     try:
-        loader = TestLoader(roidb, cfg, batch_size=bs)
+        loader = TestLoader(roidb, cfg, batch_size=bs,
+                            prefetch=args.prefetch)
         stats = pred_eval(predictor, loader, imdb, thresh=args.thresh,
                           vis=args.vis, with_masks=cfg.network.HAS_MASK,
-                          det_cache=args.dets_cache or None)
+                          det_cache=args.dets_cache or None,
+                          inflight=args.eval_inflight,
+                          host_workers=args.eval_host_workers,
+                          device_postprocess=args.device_postprocess)
     finally:
         obs.close()
 

@@ -1,0 +1,359 @@
+"""Overlapped-eval pipeline pins (eval/pipeline.py + --device-postprocess).
+
+Three contracts guard the tentpole:
+
+* BIT-IDENTITY: the pipelined loop fills the exact same ``all_boxes`` /
+  ``all_masks`` as the serial reference loop at ANY in-flight depth —
+  results are index-addressed, so overlap can change timing only, never
+  content.  Exercised including the repeat-padded tail batch and the
+  mask pass.
+* DEVICE-POSTPROCESS PARITY: the fused decode+NMS program keeps the same
+  detections as the host path (ops-level exact on tie-free inputs;
+  end-to-end within float tolerance on a real model).
+* STALE-CACHE SAFETY: under overlap the pyramid cache belongs to the
+  NEWEST dispatch; the captured ``(feats, token)`` handle keeps batch N's
+  mask pass correct, and the token assert still fails loudly without it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.eval.tester import _Progress, pred_eval
+
+
+class BatchVaryingStub:
+    """Duck-typed predictor whose outputs differ per predict() call — a
+    pipeline that mixed up batch→index mapping cannot pass the identity
+    test with it.  predict() is always called from the main thread in
+    loader order (the pipeline dispatches in order), so the call counter
+    is deterministic on both paths."""
+
+    def __init__(self, cfg, num_classes=3, R=12, mask=False):
+        self.cfg = cfg
+        self.K = num_classes
+        self.R = R
+        self._calls = 0
+        self.mask_calls = 0
+        self._mask = mask
+
+    def predict(self, images, im_info):
+        import jax.numpy as jnp
+
+        B = images.shape[0]
+        rng = np.random.RandomState(1000 + self._calls)
+        self._calls += 1
+        boxes = np.zeros((B, self.R, 4), np.float32)
+        for r in range(self.R):
+            x, y = 10 * (r % 6), 20 * (r // 6)
+            boxes[:, r] = (x, y, x + 8, y + 8)
+        scores = rng.uniform(0.05, 1.0, (B, self.R, self.K)).astype(
+            np.float32)
+        deltas = jnp.zeros((B, self.R, 4 * self.K), jnp.float32)
+        return (jnp.asarray(boxes), jnp.ones((B, self.R), bool),
+                jnp.asarray(scores), deltas, None)
+
+    def predict_masks_cached(self, boxes, labels, token=None):
+        self.mask_calls += 1
+        B, R = labels.shape
+        return np.full((B, R, 28, 28), 0.9, np.float32)
+
+    def predict_masks_packed(self, boxes, labels, orig_boxes, hp, wp,
+                             token=None):
+        from mx_rcnn_tpu.ops.mask_paste import paste_masks
+
+        probs = self.predict_masks_cached(boxes, labels, token)
+        return paste_masks(probs, orig_boxes, hp, wp)
+
+
+class MultiBatchLoader:
+    """num_images images at batch_size, sequential, repeat-padded tail —
+    the TestLoader batching contract without the image decode."""
+
+    def __init__(self, num_images, batch_size, H=64, W=96):
+        self.roidb = [{"height": H, "width": W} for _ in range(num_images)]
+        self.batch_size = batch_size
+        self.H, self.W = H, W
+
+    def __iter__(self):
+        n = len(self.roidb)
+        bs = self.batch_size
+        out = []
+        for start in range(0, n, bs):
+            idx = list(range(start, min(start + bs, n)))
+            pad = bs - len(idx)
+            out.append(dict(
+                images=np.zeros((bs, self.H, self.W, 3), np.float32),
+                im_info=np.tile(np.asarray([[self.H, self.W, 1.0]],
+                                           np.float32), (bs, 1)),
+                indices=np.asarray(idx + [idx[-1]] * pad, np.int32),
+                batch_valid=np.asarray([True] * len(idx) + [False] * pad),
+            ))
+        return iter(out)
+
+
+class RecordingIMDB:
+    def __init__(self, num_classes, num_images, with_sds=False):
+        self.num_classes = num_classes
+        self.num_images = num_images
+        self.captured = {}
+        if with_sds:
+            self.evaluate_sds = self._evaluate_sds
+
+    def evaluate_detections(self, all_boxes):
+        self.captured["boxes"] = all_boxes
+        return {"mAP": 0.0}
+
+    def _evaluate_sds(self, all_boxes, all_masks):
+        self.captured["boxes"] = all_boxes
+        self.captured["masks"] = all_masks
+        return {"bbox": {"mAP": 0.0}}
+
+
+def _run(inflight, mask=False, host_workers=2, num_images=5, batch_size=2):
+    cfg = generate_config("resnet101_fpn_mask" if mask else "resnet101",
+                          "PascalVOC")
+    K = 3
+    imdb = RecordingIMDB(K, num_images, with_sds=mask)
+    pred = BatchVaryingStub(cfg, num_classes=K, mask=mask)
+    pred_eval(pred, MultiBatchLoader(num_images, batch_size), imdb,
+              max_per_image=6, thresh=0.05, with_masks=mask,
+              inflight=inflight, host_workers=host_workers)
+    return imdb.captured
+
+
+def _assert_boxes_identical(a, b):
+    assert len(a) == len(b)
+    for k in range(1, len(a)):
+        for i in range(len(a[k])):
+            ax, bx = a[k][i], b[k][i]
+            assert (ax is None) == (bx is None), (k, i)
+            if ax is not None:
+                # bit-identity, not allclose: same numpy math on the same
+                # readback must produce the same bytes
+                np.testing.assert_array_equal(ax, bx, err_msg=f"{k},{i}")
+
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_pipelined_matches_serial_any_depth(depth):
+    """all_boxes bit-identical between the serial oracle (inflight=0) and
+    the pipelined loop at depths 1/2/4 — including the repeat-padded
+    tail batch (5 images at batch_size 2)."""
+    serial = _run(inflight=0)
+    piped = _run(inflight=depth)
+    _assert_boxes_identical(serial["boxes"], piped["boxes"])
+
+
+def test_pipelined_matches_serial_with_masks():
+    """Mask pass rides the pipeline: RLEs land on the same rows with the
+    same contents, tail batch included."""
+    serial = _run(inflight=0, mask=True)
+    piped = _run(inflight=2, mask=True)
+    _assert_boxes_identical(serial["boxes"], piped["boxes"])
+    sm, pm = serial["masks"], piped["masks"]
+    for k in range(1, len(sm)):
+        for i in range(len(sm[k])):
+            assert (sm[k][i] is None) == (pm[k][i] is None)
+            if sm[k][i] is not None:
+                assert sm[k][i] == pm[k][i], (k, i)
+
+
+def test_pipelined_det_cache_identical(tmp_path):
+    """The det_cache pickle is path-agnostic too (tools/reeval.py input)."""
+    cfg = generate_config("resnet101", "PascalVOC")
+    outs = []
+    for inflight in (0, 2):
+        imdb = RecordingIMDB(3, 5)
+        path = tmp_path / f"dets_{inflight}.pkl"
+        pred_eval(BatchVaryingStub(cfg, num_classes=3),
+                  MultiBatchLoader(5, 2), imdb, max_per_image=6,
+                  thresh=0.05, inflight=inflight, det_cache=str(path))
+        with open(path, "rb") as f:
+            outs.append(pickle.load(f))
+    _assert_boxes_identical(outs[0], outs[1])
+
+
+def test_progress_monotonic_thresholds():
+    """The old gauge fired on ``done % 100 < len(dets)`` — it could fire
+    several batches in a row (done=102,105 with batch 3... no: 102 then
+    205) or skip a century when a large batch strode past it.  The
+    replacement fires exactly once per crossed threshold, monotonically."""
+    fired = []
+
+    class Tel:
+        def gauge(self, name, value):
+            fired.append(name)
+
+    p = _Progress(total=1000, n_chips=1, every=100)
+    tel = Tel()
+    for done in (40, 99, 100, 102, 150, 199, 200, 201, 550):
+        p.update(done, tel)
+    # fires at 100, 200 and 550 (crossing 300/400/500 in one leap fires
+    # once, then re-arms at 600) — never twice inside one century
+    assert len(fired) == 3
+
+
+def test_registry_key_accepts_static_string_tokens():
+    """predict_detections folds its baked-in statics into the shape key as
+    strings ("mpi=100") — the key must stay hashable, keep batch
+    extraction from the leading int dims, and round-trip the tokens."""
+    from mx_rcnn_tpu.compile.registry import ProgramRegistry
+
+    cfg = generate_config("resnet101", "PascalVOC")
+    reg = ProgramRegistry(cfg)
+    key = reg.key_for("predict_post", (4, 96, 128, 3, "mpi=100",
+                                       "th=0.001"))
+    assert key.batch == 4
+    assert key.shape == (4, 96, 128, 3, "mpi=100", "th=0.001")
+    assert hash(key) == hash(reg.key_for("predict_post",
+                                         (4, 96, 128, 3, "mpi=100",
+                                          "th=0.001")))
+    # distinct statics are distinct programs
+    assert key != reg.key_for("predict_post", (4, 96, 128, 3, "mpi=50",
+                                               "th=0.001"))
+
+
+def _grid_inputs(B=2, R=12, K=3, seed=0):
+    """Well-separated boxes (NMS keeps everything) + tie-free scores →
+    the host and device paths must agree EXACTLY (same selections, same
+    order), leaving only the float math to compare."""
+    rng = np.random.RandomState(seed)
+    rois = np.zeros((B, R, 4), np.float32)
+    for r in range(R):
+        x, y = 30 * (r % 4), 25 * (r // 4)
+        rois[:, r] = (x, y, x + 8, y + 8)
+    deltas = np.zeros((B, R, 4 * K), np.float32)
+    scores = rng.permutation(np.linspace(0.1, 0.95, B * R * K)).reshape(
+        B, R, K).astype(np.float32)
+    valid = np.ones((B, R), bool)
+    im_info = np.tile(np.asarray([[100, 120, 1.0]], np.float32), (B, 1))
+    return rois, valid, scores, deltas, im_info
+
+
+def test_device_postprocess_parity_ops_level():
+    """device_postprocess + device_dets_to_per_class == decode_image_boxes
+    + per_class_nms on tie-free, well-separated inputs."""
+    import jax
+
+    from mx_rcnn_tpu.ops.postprocess import (decode_image_boxes,
+                                             device_dets_to_per_class,
+                                             device_postprocess,
+                                             per_class_nms)
+
+    rois, valid, scores, deltas, im_info = _grid_inputs()
+    K = 3
+    dets, dvalid = jax.device_get(device_postprocess(
+        rois, valid, scores, deltas, im_info, num_classes=K, thresh=0.3,
+        nms_thresh=0.3, max_per_image=10))
+    for b in range(rois.shape[0]):
+        dev = device_dets_to_per_class(dets[b], dvalid[b], K)
+        boxes = decode_image_boxes(rois[b], deltas[b], im_info[b])
+        host = per_class_nms(scores[b], boxes, valid[b], K, 0.3, 0.3, 10)
+        for k in range(1, K):
+            assert dev[k].shape == host[k].shape, (b, k)
+            np.testing.assert_allclose(dev[k], host[k], atol=1e-4,
+                                       err_msg=f"{b},{k}")
+
+
+def test_device_postprocess_respects_cap_and_order():
+    """The fused path honors max_per_image exactly and returns rows
+    score-descending with the class id in column 5."""
+    import jax
+
+    from mx_rcnn_tpu.ops.postprocess import device_postprocess
+
+    rois, valid, scores, deltas, im_info = _grid_inputs()
+    dets, dvalid = jax.device_get(device_postprocess(
+        rois, valid, scores, deltas, im_info, num_classes=3, thresh=0.05,
+        nms_thresh=0.3, max_per_image=4))
+    for b in range(rois.shape[0]):
+        rows = dets[b][np.asarray(dvalid[b], bool)]
+        assert len(rows) == 4
+        s = rows[:, 4]
+        assert (s[:-1] >= s[1:]).all()
+        assert set(np.unique(rows[:, 5])) <= {1.0, 2.0}
+
+
+def _tiny_predictor(mask=False):
+    import jax
+
+    from mx_rcnn_tpu.eval import Predictor
+    from mx_rcnn_tpu.models import build_model, init_params
+
+    cfg = generate_config(
+        "resnet101_fpn_mask" if mask else "resnet50", "PascalVOC",
+        TEST__RPN_PRE_NMS_TOP_N=300, TEST__RPN_POST_NMS_TOP_N=32)
+    net = dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4))
+    tpu = dataclasses.replace(cfg.tpu, SCALES=((96, 128),), MAX_GT=8)
+    cfg = cfg.replace(network=net, tpu=tpu)
+    model = build_model(cfg)
+    params = init_params(model, cfg, jax.random.PRNGKey(0), 1, (96, 128))
+    return Predictor(model, params, cfg), cfg
+
+
+def test_device_postprocess_end_to_end_parity():
+    """Real model: pred_eval with --device-postprocess keeps the same
+    detections as the host-NMS path (per-class counts equal, boxes/scores
+    within float tolerance), and pipelined devpost == serial devpost
+    exactly."""
+    from mx_rcnn_tpu.data import SyntheticDataset, TestLoader
+
+    pred, cfg = _tiny_predictor()
+    ds = SyntheticDataset(num_images=3, height=96, width=128)
+    roidb = ds.gt_roidb()
+
+    def run(devpost, inflight):
+        imdb = RecordingIMDB(ds.num_classes, ds.num_images)
+        pred_eval(pred, TestLoader(roidb, cfg, batch_size=1), imdb,
+                  device_postprocess=devpost, inflight=inflight)
+        return imdb.captured["boxes"]
+
+    host = run(False, 0)
+    dev_serial = run(True, 0)
+    dev_piped = run(True, 2)
+    # same fused program, same inputs → pipelining is bit-invisible
+    _assert_boxes_identical(dev_serial, dev_piped)
+    for k in range(1, ds.num_classes):
+        for i in range(ds.num_images):
+            h, d = host[k][i], dev_serial[k][i]
+            assert len(h) == len(d), (k, i)
+            if len(h):
+                np.testing.assert_allclose(d, h, atol=1e-3,
+                                           err_msg=f"{k},{i}")
+
+
+def test_stale_pyramid_cache_under_overlap():
+    """The overlap hazard the capture API exists for: after batch N+1's
+    forward overwrites the cache, batch N's token must fail loudly, and
+    the captured (feats, token) pair must keep N's mask pass correct."""
+    import jax
+    import numpy as np
+
+    pred, cfg = _tiny_predictor(mask=True)
+    B, H, W = 1, 96, 128
+    rng = np.random.RandomState(0)
+    img1 = rng.uniform(0, 1, (B, H, W, 3)).astype(np.float32)
+    img2 = rng.uniform(0, 1, (B, H, W, 3)).astype(np.float32)
+    info = np.asarray([[H, W, 1.0]], np.float32)
+    boxes = np.asarray([[[10, 10, 60, 60]]], np.float32)
+    labels = np.ones((B, 1), np.int32)
+
+    pred.predict(img1, info)
+    feats1, tok1 = pred.capture_feats()
+    want = np.asarray(jax.device_get(
+        pred.predict_masks_cached(boxes, labels, token=tok1)))
+    pred.predict(img2, info)  # overwrites the cache (the overlap hazard)
+    with pytest.raises(AssertionError, match="stale pyramid cache"):
+        pred.predict_masks_cached(boxes, labels, token=tok1)
+    # the captured handle still addresses batch 1's pyramid
+    got = np.asarray(jax.device_get(
+        pred.predict_masks_cached(boxes, labels, token=tok1,
+                                  feats=feats1)))
+    np.testing.assert_array_equal(got, want)
+    # and batch 2's own token works against the live cache
+    pred.predict_masks_cached(boxes, labels, token=pred.feats_token)
