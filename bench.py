@@ -516,7 +516,8 @@ def bench_infer_loader(batch: int, network: str = "resnet101"):
     return best
 
 
-def bench_serve(batch: int, network: str = "resnet101"):
+def bench_serve(batch: int, network: str = "resnet101",
+                serve_e2e: bool = False):
     """Steady-state imgs/sec through the REAL serving engine — the number
     capacity planning needs (how many replicas for X qps), distinct from
     ``--mode infer``'s forward-only rate by exactly the serving tax:
@@ -550,7 +551,7 @@ def bench_serve(batch: int, network: str = "resnet101"):
     pred = Predictor(model, params, cfg)
     engine = ServeEngine(pred, cfg, ServeOptions(
         batch_size=batch, max_delay_ms=5.0,
-        max_queue=max(8 * batch, 16))).start()
+        max_queue=max(8 * batch, 16), serve_e2e=serve_e2e)).start()
     t_w = time.perf_counter()
     warmup(engine)
     # warmup's dummy batches run the full submit→serve path, so the end
@@ -602,11 +603,21 @@ def bench_serve(batch: int, network: str = "resnet101"):
         # p99 alongside throughput — "fast but slow-tailed" is visible
         h = engine.hists["serve/request_time"]
         p50, p99 = h.quantile(0.5), h.quantile(0.99)
+        # boundary-crossing accounting from the engine's own counters:
+        # readback_bytes_per_image is THE fused-path deliverable on a CPU
+        # box (the wall-clock win is claimed on TPU), host_prep_ms is the
+        # per-request submit-thread prep tax the fusion moves on device
+        c = dict(engine.counters)
+        readback_per_img = (c.get("readback_bytes", 0)
+                            / max(c.get("served", 0), 1))
+        host_prep_ms = (c.get("host_prep_ms_total", 0.0)
+                        / max(c.get("requests", 0), 1))
         engine.stop()
     return (best,
             (None if p50 is None else round(p50 * 1e3, 3)),
             (None if p99 is None else round(p99 * 1e3, 3)),
-            round(cold_start_s, 3), round(warmup_compile_s, 3))
+            round(cold_start_s, 3), round(warmup_compile_s, 3),
+            round(readback_per_img, 1), round(host_prep_ms, 3))
 
 
 def bench_infer_mask(batch: int, network: str = "resnet101_fpn_mask"):
@@ -704,6 +715,12 @@ def main():
                     help="pipeline mode: sweep device-side preprocessing "
                          "as a matrix axis (each k×w×p cell runs host-prep "
                          "AND device-prep)")
+    ap.add_argument("--serve-e2e", action="store_true", dest="serve_e2e",
+                    help="serve mode: run the engine with the fused "
+                         "single-dispatch serve_e2e program (staged uint8 "
+                         "in, (B, cap, 6) detections out).  The metric is "
+                         "suffixed _e2e — its own baseline series, never "
+                         "compared against the unfused engine rows")
     ap.add_argument("--pipeline-images", type=int, default=32,
                     dest="pipeline_images",
                     help="pipeline mode: synthetic roidb size per epoch")
@@ -842,8 +859,10 @@ def main():
         metric = "infer_imgs_per_sec_mask_eval"
     elif args.mode == "serve":
         (value, serve_p50_ms, serve_p99_ms, serve_cold_start_s,
-         serve_warmup_s) = bench_serve(args.batch, args.network)
-        metric = "serve_imgs_per_sec"
+         serve_warmup_s, serve_readback_b, serve_prep_ms) = bench_serve(
+             args.batch, args.network, serve_e2e=args.serve_e2e)
+        metric = ("serve_imgs_per_sec_e2e" if args.serve_e2e
+                  else "serve_imgs_per_sec")
         infer_method = "engine"  # not comparable to forward-only rows
     elif args.mode == "eval":
         eval_rates = bench_eval(args.batch, args.network)
@@ -974,6 +993,11 @@ def main():
         # a cold-start regression (lost AOT warm start) fails the gate
         out["cold_start_s"] = serve_cold_start_s
         out["warmup_compile_s"] = serve_warmup_s
+        # direction=down in perf_gate too: the e2e readback shrink (full
+        # (R,K)+(R,4K) tensors → (B,cap,6) detections) can never silently
+        # regress, and host_prep_ms pins the submit-thread prep tax
+        out["readback_bytes_per_image"] = serve_readback_b
+        out["host_prep_ms"] = serve_prep_ms
     if opt_acc is not None:
         out["opt_acc"] = opt_acc
     if eval_rates is not None:

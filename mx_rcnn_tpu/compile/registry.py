@@ -66,7 +66,11 @@ CACHE_SCHEMA = "mxr-programs-v1"
 
 ENV_CACHE_BASE = "MXR_PROGRAM_CACHE"
 
-INFER_DTYPES = ("float32", "bfloat16", "int8")
+INFER_DTYPES = ("float32", "bfloat16", "int8", "int8-activation")
+
+# schema tag for the activation-scale manifest persisted next to the AOT
+# program markers — bump when the calibration doc layout changes
+ACT_SCALES_SCHEMA = "mxr-act-scales-v1"
 
 
 def config_digest(cfg) -> str:
@@ -260,6 +264,57 @@ class ProgramRegistry:
             os.replace(tmp, path)  # atomic: concurrent ranks race benignly
         except OSError as e:
             logger.warning("program registry: marker write failed (%s)", e)
+
+    # -- activation-scale manifest (int8-activation calibration) ---------
+
+    def act_scales_path(self) -> Optional[str]:
+        """Where this registry persists calibrated activation scales —
+        next to the AOT program markers, keyed by config digest, so a
+        warm boot of the same config finds the same calibration the AOT
+        executables were built against."""
+        if not self.cache_dir:
+            return None
+        return os.path.join(self.cache_dir, "programs",
+                            f"act_scales-{self.digest}.json")
+
+    def save_act_scales(self, tensors: Dict[str, dict]) -> Optional[str]:
+        """Persist per-tensor calibration scales (``{"tensor": {"absmax",
+        "scale"}}``) atomically; returns the path (None when no cache dir
+        is configured — calibration then lives only in-process)."""
+        path = self.act_scales_path()
+        if not path:
+            return None
+        doc = {"schema": ACT_SCALES_SCHEMA, "digest": self.digest,
+               "tensors": tensors}
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("program registry: act-scales write failed (%s)",
+                           e)
+            return None
+        return path
+
+    def load_act_scales(self) -> Optional[Dict[str, dict]]:
+        """Load the persisted calibration manifest for this config digest
+        (None when absent/unreadable/schema-mismatched — callers fall
+        back to the weight-only int8 behavior)."""
+        path = self.act_scales_path()
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if (doc.get("schema") != ACT_SCALES_SCHEMA
+                or doc.get("digest") != self.digest):
+            return None
+        tensors = doc.get("tensors")
+        return tensors if isinstance(tensors, dict) else None
 
     # -- dispatch accounting --------------------------------------------
 

@@ -269,12 +269,15 @@ class TPUConfig:
     # (BASELINE.md batch-scaling table).  Param tree and numerics are
     # unchanged; off by default pending the on-chip A/B.
     REMAT_BACKBONE: bool = False
-    # device-side preprocessing (data/device_prep.py): train loaders emit
-    # raw bucket-staged uint8 pixels and a jitted per-bucket program does
+    # device-side preprocessing (data/device_prep.py): loaders emit raw
+    # bucket-staged uint8 pixels and a jitted per-bucket program does
     # resize/flip/normalize/pad (and HOST_S2D) on device, overlapped with
     # the step via the prefetch thread.  Off (default) keeps the host
-    # numpy path bit-identical to before the feature existed.  Train-path
-    # only: TestLoader and the serve engine always use the host path.
+    # numpy path bit-identical to before the feature existed.  Train
+    # loaders honor it directly; eval opts in per TestLoader
+    # (test.py --device-prep → Predictor.batch_put preps on device); the
+    # serve engine's fused equivalent is --serve-e2e.  Mesh plans raise —
+    # host prep only there.
     DEVICE_PREP: bool = False
     # output dtype of the device preprocess program ("float32" or
     # "bfloat16") — the host path is float32-only

@@ -538,11 +538,18 @@ class TestLoader:
     __test__ = False  # not a pytest class
 
     def __init__(self, roidb: list, cfg: Config, batch_size: int = 1,
-                 prefetch: Optional[int] = None):
+                 prefetch: Optional[int] = None,
+                 device_prep: bool = False):
         self.roidb = roidb
-        if getattr(cfg.tpu, "DEVICE_PREP", False):
-            # device prep is a TRAIN-path feature; eval stays on the
-            # bit-identical host transform (Predictor has no prep hook)
+        if getattr(cfg.tpu, "DEVICE_PREP", False) and not device_prep:
+            # opt-in per loader: a train cfg with DEVICE_PREP on reaches
+            # here from drivers whose consumer installs no prep hook
+            # (proposal dumps, bench oracles) — those stay on the
+            # bit-identical host transform.  ``device_prep=True`` (test.py
+            # --device-prep) keeps the sidecars; the Predictor's
+            # ``batch_put`` then preps on device (same jitted kernel and
+            # host-bilinear parity pin as train; mesh plans raise at
+            # Predictor construction).
             import dataclasses as _dc
 
             cfg = _dc.replace(cfg, tpu=_dc.replace(cfg.tpu,

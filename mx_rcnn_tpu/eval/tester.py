@@ -36,7 +36,10 @@ def _variant_params(params, dtype: str):
     runs in ``cfg.tpu.COMPUTE_DTYPE``); ``int8`` stores per-leaf
     symmetric-quantized weights as ``(int8 values, f32 scale)`` tuples,
     dequantized inside the jitted program — a memory-bound-serving
-    variant, tolerance-tested more loosely than bf16."""
+    variant, tolerance-tested more loosely than bf16.
+    ``int8-activation`` quantizes weights identically AND fake-quantizes
+    the network-input activations against calibrated per-tensor scales
+    (see :func:`calibrate_activation_scales`)."""
     import jax.numpy as jnp
 
     if dtype == "float32":
@@ -64,7 +67,7 @@ def _make_unpack(dtype: str):
     other variants pass through."""
     import jax.numpy as jnp
 
-    if dtype != "int8":
+    if dtype not in ("int8", "int8-activation"):
         return lambda p: p
 
     def dq(t):
@@ -75,6 +78,35 @@ def _make_unpack(dtype: str):
 
     return lambda p: jax.tree.map(dq, p,
                                   is_leaf=lambda t: isinstance(t, tuple))
+
+
+def _make_quant_in(dtype: str, act_scales):
+    """Activation fake-quant for the ``int8-activation`` variant (traced
+    under jit): the normalized image tensor entering the network is
+    symmetric-quantized to 8 bits against its calibrated per-tensor scale
+    and immediately dequantized — the forward then sees exactly the
+    values an int8 activation path would, so the parity pin measures the
+    real quantization error, not a kernel substitution.  Without a
+    calibrated ``"images"`` scale (no calibration ran and none persisted)
+    the variant degrades to weight-only int8 — safe, just unquantized
+    activations."""
+    import jax.numpy as jnp
+
+    if dtype != "int8-activation":
+        return lambda x: x
+    info = (act_scales or {}).get("images") or {}
+    s = float(info.get("scale", 0.0) or 0.0)
+    if s <= 0.0:
+        logger.warning("int8-activation without calibrated scales: "
+                       "activations stay float (run --calibrate-shard "
+                       "or persist scales in the program cache)")
+        return lambda x: x
+
+    def fq(x):
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127.0, 127.0)
+        return (q * s).astype(x.dtype)
+
+    return fq
 
 
 def _make_cast_out(dtype: str):
@@ -111,7 +143,7 @@ class Predictor:
     """
 
     def __init__(self, model, params, cfg: Config, plan=None,
-                 dtype: str = "float32", cache_base=None):
+                 dtype: str = "float32", cache_base=None, act_scales=None):
         if dtype not in INFER_DTYPES:
             raise ValueError(f"infer dtype must be one of {INFER_DTYPES}, "
                              f"got {dtype!r}")
@@ -121,9 +153,25 @@ class Predictor:
         self.infer_dtype = dtype
         self.registry = ProgramRegistry(cfg, dtype=dtype, plan=plan,
                                         cache_base=cache_base)
+        if dtype == "int8-activation" and act_scales is None:
+            # calibration persists next to the AOT marker manifest — a
+            # warm boot of the same config digest finds the scales the
+            # cached executables were traced against
+            act_scales = self.registry.load_act_scales()
+        self.act_scales = act_scales
+        # eval-side device prep (cfg.tpu.DEVICE_PREP + TestLoader
+        # device_prep=True): batch_put consumes the staged-uint8 sidecars
+        # through the same jitted kernel train uses.  maybe_device_prep
+        # raises the explicit ValueError under a mesh plan — the prep
+        # output would need the plan's input sharding.
+        from mx_rcnn_tpu.data.device_prep import maybe_device_prep
+
+        self._device_prep = maybe_device_prep(cfg, registry=self.registry,
+                                              plan=plan)
         params = _variant_params(params, dtype)
         unpack = _make_unpack(dtype)
         cast_out = _make_cast_out(dtype)
+        quant_in = _make_quant_in(dtype, act_scales)
         if plan is not None:
             from mx_rcnn_tpu.parallel import check_spatial
             from mx_rcnn_tpu.parallel.distributed import is_multiprocess_mesh
@@ -172,7 +220,8 @@ class Predictor:
 
         def fwd(method):
             def f(p, images, im_info):
-                return cast_out(model.apply({"params": unpack(p)}, images,
+                return cast_out(model.apply({"params": unpack(p)},
+                                            quant_in(images),
                                             im_info, method=method))
             return f
 
@@ -183,8 +232,8 @@ class Predictor:
                                      method=model._pyramid)))
         if self._has_mask:
             def fwd_wf(p, images, im_info):
-                out, feats = model.apply({"params": unpack(p)}, images,
-                                         im_info,
+                out, feats = model.apply({"params": unpack(p)},
+                                         quant_in(images), im_info,
                                          method=model.predict_with_feats)
                 # feats stay in native compute dtype: they only feed the
                 # mask programs below, never the host
@@ -234,11 +283,12 @@ class Predictor:
 
             def f(p, images, im_info):
                 if has_mask:
-                    out, feats = model.apply({"params": unpack(p)}, images,
-                                             im_info,
+                    out, feats = model.apply({"params": unpack(p)},
+                                             quant_in(images), im_info,
                                              method=model.predict_with_feats)
                 else:
-                    out = model.apply({"params": unpack(p)}, images, im_info,
+                    out = model.apply({"params": unpack(p)},
+                                      quant_in(images), im_info,
                                       method=model.predict)
                     feats = None
                 # cast BEFORE the decode: low-precision variants must not
@@ -260,6 +310,51 @@ class Predictor:
 
         reg.register("predict_post", build_post)
 
+        # fused prep + forward + decode + NMS ("--serve-e2e"): the serve
+        # engine ships staged raw uint8 (data/image.py stage_raw_to_bucket)
+        # plus the raw_hw/ratio/flip sidecars and reads back only the
+        # (B, cap, 6) detections — one uint8 h2d transfer, one dispatch,
+        # one tiny readback per request batch.  Prep constants mirror
+        # data/device_prep.DevicePrep exactly (same _prep_one kernel), so
+        # the fused path inherits its host-bilinear parity pin.
+        net = cfg.network
+
+        def build_serve_e2e(max_per_image, thresh):
+            import jax.numpy as jnp
+
+            from mx_rcnn_tpu.data.device_prep import _prep_one
+            from mx_rcnn_tpu.ops.postprocess import device_postprocess
+
+            mean = jnp.asarray(net.PIXEL_MEANS, jnp.float32)
+            std = jnp.asarray(net.PIXEL_STDS, jnp.float32)
+            s2d = bool(net.HOST_S2D)
+
+            def one(raw, hw, rt, ii, fl):
+                return _prep_one(raw, hw, rt, ii, fl, mean, std, s2d,
+                                 jnp.float32)
+
+            def f(p, staged, raw_hw, ratio, im_info, flip):
+                images = quant_in(jax.vmap(one)(staged, raw_hw, ratio,
+                                                im_info, flip))
+                if has_mask:
+                    out, _ = model.apply({"params": unpack(p)}, images,
+                                         im_info,
+                                         method=model.predict_with_feats)
+                else:
+                    out = model.apply({"params": unpack(p)}, images,
+                                      im_info, method=model.predict)
+                rois, roi_valid, cls_prob, bbox_deltas = cast_out(out[:4])
+                return device_postprocess(
+                    rois, roi_valid, cls_prob, bbox_deltas,
+                    jnp.asarray(im_info, jnp.float32),
+                    num_classes=cfg.NUM_CLASSES, thresh=thresh,
+                    nms_thresh=cfg.TEST.NMS, max_per_image=max_per_image,
+                    use_pallas=cfg.TEST.CXX_PROPOSAL)
+
+            return jax.jit(f)
+
+        reg.register("serve_e2e", build_serve_e2e)
+
     def batch_put(self, batch: dict) -> dict:
         """The TestLoader ``put`` hook: move ``images`` (the only large
         buffer) onto the mesh (or chip) from the prefetch thread so the
@@ -268,7 +363,24 @@ class Predictor:
         ``im_detect``/``_mask_pass`` read them back every batch, and a
         device-resident copy would add a blocked d2h round-trip per batch
         (~100-300 ms on the tunnel); jit ships the 12-byte ``im_info``
-        per call for free."""
+        per call for free.
+
+        Under eval device prep (``--device-prep``) the batch arrives as
+        staged raw uint8 + sidecars; the hook transfers those and runs the
+        jitted prep program (registry kind ``"device_prep"``), so the
+        batch leaves this hook in exactly the host-path layout — float
+        ``images`` on device, ``im_info``/``indices``/``batch_valid``
+        still numpy."""
+        if self._device_prep is not None and "raw_hw" in batch:
+            out = dict(batch)
+            raw = jax.device_put(out.pop("images"))
+            raw_hw = jax.device_put(out.pop("raw_hw"))
+            ratio = jax.device_put(out.pop("prep_ratio"))
+            flip = jax.device_put(out.pop("flip"))
+            ii = jax.device_put(np.asarray(out["im_info"], np.float32))
+            out["images"] = self._device_prep._run(raw, raw_hw, ratio, ii,
+                                                   flip)
+            return out
         sh = self.plan.images() if self.plan is not None else None
         out = dict(batch)
         out["images"] = (jax.device_put(batch["images"], sh)
@@ -291,18 +403,34 @@ class Predictor:
         self._feats = None  # cached pyramid belongs to the old weights
         self._feats_token = None
 
-    def note_dispatch(self, shape) -> bool:
-        """Registry first-seen accounting for the program ``predict`` will
-        dispatch on ``shape`` — True exactly once per shape per process
-        (the serve engine's recompile-counter signal)."""
-        kind = "predict_wf" if self._has_mask else "predict"
+    def note_dispatch(self, shape, kind: Optional[str] = None) -> bool:
+        """Registry first-seen accounting for the program that will
+        dispatch on ``shape`` — True exactly once per (kind, shape) per
+        process (the serve engine's recompile-counter signal).  ``kind``
+        defaults to the legacy forward program; the fused serve path
+        passes ``"serve_e2e"`` so its programs are labeled apart."""
+        if kind is None:
+            kind = "predict_wf" if self._has_mask else "predict"
         return self.registry.note_dispatch(kind, shape)
 
-    def record_compile_seconds(self, shape, seconds: float) -> None:
+    def record_compile_seconds(self, shape, seconds: float,
+                               kind: Optional[str] = None) -> None:
         """Companion to :meth:`note_dispatch` for callers (the serve
         engine) that own the first-dispatch timing themselves."""
-        kind = "predict_wf" if self._has_mask else "predict"
+        if kind is None:
+            kind = "predict_wf" if self._has_mask else "predict"
         self.registry.record_compile_seconds(kind, shape, seconds)
+
+    @staticmethod
+    def serve_e2e_shape(staged_shape, max_per_image, thresh):
+        """The registry shape key of the fused serve program for a staged
+        uint8 batch — the baked-in statics ride along as string tokens
+        (two configs differing only in cap/threshold are different
+        executables).  The serve engine uses this for its first-dispatch
+        accounting so its counter and :meth:`predict_serve_e2e` agree on
+        program identity."""
+        return tuple(staged_shape) + (f"mpi={int(max_per_image)}",
+                                      f"th={float(thresh):g}")
 
     def _dispatch(self, kind, shape, fn, *args):
         """Run one registered program; on its first dispatch, block and
@@ -351,6 +479,20 @@ class Predictor:
             self._feats = feats
             return dets, dvalid
         return self._dispatch("predict_post", shape, fn, images, im_info)
+
+    def predict_serve_e2e(self, staged, raw_hw, ratio, im_info, flip,
+                          max_per_image, thresh):
+        """Single-dispatch serving program: staged raw uint8 + sidecars in,
+        ``((B, cap, 6) dets, (B, cap) valid)`` out, both still on device.
+        Device prep, the forward, and decode+NMS run fused — the caller
+        (serve engine) does one ``device_put`` of the argument tuple, one
+        call here, one ``device_get`` of the return."""
+        mpi = int(max_per_image)
+        th = float(thresh)
+        fn = self.registry.lookup("serve_e2e", static=(mpi, th))
+        shape = self.serve_e2e_shape(staged.shape, mpi, th)
+        return self._dispatch("serve_e2e", shape, fn, staged, raw_hw,
+                              ratio, im_info, flip)
 
     @property
     def feats_token(self):
@@ -432,6 +574,66 @@ class Predictor:
     def _pyramid(self, images):
         return self._dispatch("pyramid", images.shape,
                               self.registry.lookup("pyramid"), images)
+
+
+def calibrate_activation_scales(model, params, cfg: Config, raw_images,
+                                max_images: int = 8,
+                                capture: bool = True) -> dict:
+    """Activation-calibration pass for ``--infer-dtype int8-activation``:
+    run the FLOAT model over a held-out shard of raw uint8 images and
+    record a per-tensor symmetric absmax scale for every activation the
+    pass can observe — the normalized network input plus (when
+    ``capture`` and the model supports flax intermediate capture) every
+    module output.  Returns ``{tensor: {"absmax", "scale"}}``; persist it
+    with :meth:`ProgramRegistry.save_act_scales` so warm boots of the
+    same config digest reuse the calibration their AOT executables were
+    traced against.
+
+    ``params`` must be the float32 tree (calibration observes the model
+    the quantized variant approximates, not the variant itself)."""
+    from mx_rcnn_tpu.data.loader import prepare_image
+
+    scale = cfg.tpu.SCALES[0]
+    absmax: dict = {}
+
+    def acc(name, x):
+        x = np.asarray(x)
+        if x.dtype.kind != "f" or x.size == 0:
+            return
+        absmax[name] = max(absmax.get(name, 0.0),
+                           float(np.max(np.abs(x))))
+
+    seen = 0
+    for im in raw_images:
+        if seen >= max_images:
+            break
+        padded, info = prepare_image(np.asarray(im), cfg, scale)
+        acc("images", padded)
+        if capture:
+            try:
+                _, state = model.apply(
+                    {"params": params}, padded[None],
+                    np.asarray(info, np.float32)[None],
+                    method=model.predict, capture_intermediates=True)
+                leaves = jax.tree_util.tree_flatten_with_path(
+                    dict(state).get("intermediates", {}))[0]
+                for path, leaf in leaves:
+                    name = "/".join(str(getattr(k, "key", k))
+                                    for k in path)
+                    acc(name, jax.device_get(leaf))
+            except Exception as e:
+                logger.warning("calibration: intermediate capture "
+                               "unavailable (%s); input-tensor scale only",
+                               e)
+                capture = False
+        seen += 1
+    if seen == 0:
+        raise ValueError("calibration shard is empty")
+    logger.info("calibrated %d activation tensor(s) over %d image(s)",
+                len(absmax), seen)
+    return {name: {"absmax": round(a, 6),
+                   "scale": round(a / 127.0, 9) if a > 0 else 1.0}
+            for name, a in absmax.items()}
 
 
 def paste_mask(prob: np.ndarray, box: np.ndarray, h: int, w: int) -> np.ndarray:

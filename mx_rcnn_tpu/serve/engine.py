@@ -68,11 +68,12 @@ import numpy as np
 from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.telemetry import Hist
 from mx_rcnn_tpu.config import Config
-from mx_rcnn_tpu.data.image import bucket_shape
+from mx_rcnn_tpu.data.image import bucket_shape, stage_raw_to_bucket
 from mx_rcnn_tpu.data.loader import prepare_image
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.ops.postprocess import (decode_image_boxes,
                                          detections_to_records,
+                                         device_dets_to_per_class,
                                          per_class_nms)
 
 
@@ -103,6 +104,15 @@ class ServeOptions:
     # N > 0 ships it to the shared pool — the serving ingest bottleneck
     # once offered load outruns one interpreter's resize throughput
     prep_workers: int = 0
+    # single-dispatch serving (CLI --serve-e2e): submit() only STAGES the
+    # raw uint8 into its bucket (data/image.py stage_raw_to_bucket — no
+    # resize/normalize on the host), and each batch runs the fused
+    # prep → forward → decode+NMS registry program ("serve_e2e"): one
+    # h2d transfer, one dispatch, one (B, cap, 6) readback.  Off (the
+    # default) reproduces the PR-3 host-prep + host-NMS path
+    # byte-for-byte.  Staging always runs on the caller's thread — it is
+    # a pad-copy, far below the prep-worker break-even.
+    serve_e2e: bool = False
 
     def __post_init__(self):
         if self.batch_size < 1:
@@ -152,14 +162,20 @@ class ServeFuture:
 
 class _Request:
     __slots__ = ("image", "im_info", "t_enqueue", "deadline", "bucket",
-                 "future")
+                 "future", "raw_hw", "ratio")
 
-    def __init__(self, image, im_info, t_enqueue, deadline, bucket=None):
-        self.image = image          # bucket-padded network input
+    def __init__(self, image, im_info, t_enqueue, deadline, bucket=None,
+                 raw_hw=None, ratio=None):
+        self.image = image          # bucket-padded network input, or (in
+        # serve_e2e mode) the STAGED raw uint8 bucket array
         self.im_info = im_info
         self.t_enqueue = t_enqueue  # monotonic
         self.deadline = deadline    # monotonic instant or None
         self.bucket = bucket        # (H, W) routing key, for per-bucket obs
+        # serve_e2e sidecars (stage_raw_to_bucket): device prep consumes
+        # them inside the fused program; None on the legacy path
+        self.raw_hw = raw_hw        # (2,) int32 [h, w] of the raw image
+        self.ratio = ratio          # () float32 output→input sampling ratio
         self.future = ServeFuture()
 
 
@@ -205,7 +221,13 @@ class ServeEngine:
         self.counters = {"requests": 0, "served": 0, "batches": 0,
                          "rejected": 0, "shed": 0, "deadline_exceeded": 0,
                          "recompiles": 0, "warmup_programs": 0,
-                         f"recompiles_{self._dtype}": 0}
+                         f"recompiles_{self._dtype}": 0,
+                         # boundary-crossing accounting (the serve_e2e
+                         # contract: exactly 1/1/1 per batch; the legacy
+                         # path reports its own so bench can compare)
+                         "h2d_transfers": 0, "dispatches": 0,
+                         "readbacks": 0, "readback_bytes": 0,
+                         "host_prep_ms_total": 0.0}
         self._pool = None  # prep worker pool (opts.prep_workers > 0)
         # engine-authoritative latency distributions (same contract as
         # self.counters: live even with telemetry off — the controller's
@@ -215,6 +237,9 @@ class ServeEngine:
             "serve/queue_wait": Hist(),
             "serve/service_time": Hist(),
             "serve/request_time": Hist(),
+            # per-request host prep/staging wall (submit-side): the cost
+            # serve_e2e shrinks from a cv2 resize+normalize to a pad-copy
+            "serve/host_prep": Hist(),
         }
         self._bucket_hists: Dict[str, Hist] = {}  # "HxW" -> request_time
         # SLO-controller policy overrides (None/absent = configured opts);
@@ -400,17 +425,31 @@ class ServeEngine:
             raise ValueError(f"expected (H, W, 3) RGB image, "
                              f"got shape {tuple(image.shape)}")
         tel = telemetry.get()
-        # host prep off the dispatcher thread either way: on the caller's
-        # thread (workers=0 — concurrent frontends parallelize the resize)
-        # or in the shared prep worker pool (byte-identical transform,
-        # pinned by test_loader_workers), so the device hot path never
-        # waits on a resize
-        if self._pool is not None:
+        t_prep = time.perf_counter()
+        raw_hw = ratio = None
+        if self.opts.serve_e2e:
+            # single-dispatch mode: no host resize/normalize — stage the
+            # raw uint8 into its bucket (pad-copy; oversized raws shrink
+            # host-side, see stage_raw_to_bucket) and let the fused
+            # program run the prep on device
+            prepared, raw_hw, ratio, im_info = stage_raw_to_bucket(
+                np.asarray(image), self._scale,
+                max(self.cfg.network.IMAGE_STRIDE,
+                    self.cfg.network.RPN_FEAT_STRIDE))
+        elif self._pool is not None:
+            # host prep off the dispatcher thread either way: on the
+            # caller's thread (workers=0 — concurrent frontends
+            # parallelize the resize) or in the shared prep worker pool
+            # (byte-identical transform, pinned by test_loader_workers),
+            # so the device hot path never waits on a resize
             prepared, im_info = self._pool.prepare(np.asarray(image),
                                                    self._scale)
         else:
             prepared, im_info = prepare_image(np.asarray(image), self.cfg,
                                               self._scale)
+        prep_s = time.perf_counter() - t_prep
+        self.hists["serve/host_prep"].observe(prep_s)
+        tel.observe("serve/host_prep", prep_s)
         # route on the LOGICAL bucket (pre-s2d padded shape) — under
         # HOST_S2D the prepared array is (H/2, W/2, 12), but orientation
         # and program identity are the bucket's, and /metrics should name
@@ -420,7 +459,8 @@ class ServeEngine:
         if deadline_ms is None:
             deadline_ms = self.opts.deadline_ms
         deadline = now + deadline_ms / 1e3 if deadline_ms > 0 else None
-        req = _Request(prepared, im_info, now, deadline, bucket=key)
+        req = _Request(prepared, im_info, now, deadline, bucket=key,
+                       raw_hw=raw_hw, ratio=ratio)
         with self._cond:
             if self._stop:
                 self.counters["rejected"] += 1
@@ -453,6 +493,7 @@ class ServeEngine:
                     f"pending) — retry with backoff")
             self._queues.setdefault(key, []).append(req)
             self.counters["requests"] += 1
+            self.counters["host_prep_ms_total"] += prep_s * 1e3
             tel.counter("serve/requests")
             tel.gauge("serve/queue_depth", depth + 1)
             self._cond.notify()
@@ -561,42 +602,10 @@ class ServeEngine:
                            + [reqs[-1].im_info] * pad)
         tel.gauge("serve/batch_fill", len(reqs) / B)
         tel.gauge("serve/pad_ratio", pad / B)
-        shape = tuple(images.shape)
-        if self.registry is not None:
-            first = self.predictor.note_dispatch(shape)
+        if self.opts.serve_e2e:
+            xfer = self._forward_e2e(reqs, images, im_info, tel)
         else:
-            first = shape not in self._seen_shapes
-            self._seen_shapes.add(shape)
-        if first:
-            self.counters["recompiles"] += 1
-            self.counters[f"recompiles_{self._dtype}"] += 1
-            tel.counter("serve/recompile")
-            tel.counter(f"serve/recompile/{self._dtype}")
-            tel.meta("recompile", program="serve_predict", shape=list(shape),
-                     dtype=self._dtype)
-        t_fwd = time.monotonic()
-        with tel.span("serve/forward"):
-            rois, roi_valid, cls_prob, bbox_deltas, _ = \
-                self.predictor.predict(images, im_info)
-        with tel.span("serve/readback"):
-            rois, roi_valid, cls_prob, bbox_deltas = jax.device_get(
-                (rois, roi_valid, cls_prob, bbox_deltas))
-        if first and self.registry is not None:
-            # first dispatch of a shape = its compile: the forward +
-            # readback wall is the compile(+first run) cost this program
-            # would charge a cold user request
-            self.predictor.record_compile_seconds(
-                shape, time.monotonic() - t_fwd)
-        cfg = self.cfg
-        with tel.span("serve/postprocess"):
-            for b, r in enumerate(reqs):
-                boxes = decode_image_boxes(rois[b], bbox_deltas[b],
-                                           np.asarray(r.im_info))
-                dets_pc = per_class_nms(cls_prob[b], boxes, roi_valid[b],
-                                        cfg.NUM_CLASSES, cfg.TEST.THRESH,
-                                        cfg.TEST.NMS,
-                                        cfg.TEST.MAX_PER_IMAGE)
-                r.future._set_result(detections_to_records(dets_pc))
+            xfer = self._forward_legacy(reqs, images, im_info, tel)
         # latency distributions: service time once per batch, end-to-end
         # request time once per request (global + per-bucket family) —
         # into the engine's own Hists AND the active sink, so the SLO
@@ -621,8 +630,116 @@ class ServeEngine:
             self._bucket_hists.update(new_bucket_hists)
             self.counters["batches"] += 1
             self.counters["served"] += len(reqs)
+            for k, v in xfer.items():
+                self.counters[k] = self.counters.get(k, 0) + v
         tel.counter("serve/batches")
         tel.counter("serve/images", len(reqs))
+
+    def _note_first_dispatch(self, shape, kind: str, tel) -> bool:
+        """First-seen accounting for one batch's program (registry when
+        the predictor carries one, local shape set otherwise) + the
+        recompile counters/meta the SLO machinery watches."""
+        if self.registry is not None:
+            first = self.predictor.note_dispatch(shape, kind=kind) \
+                if kind == "serve_e2e" else \
+                self.predictor.note_dispatch(shape)
+        else:
+            first = (kind, shape) not in self._seen_shapes
+            self._seen_shapes.add((kind, shape))
+        if first:
+            self.counters["recompiles"] += 1
+            self.counters[f"recompiles_{self._dtype}"] += 1
+            tel.counter("serve/recompile")
+            tel.counter(f"serve/recompile/{self._dtype}")
+            tel.meta("recompile", program=kind,
+                     shape=[s for s in shape if not isinstance(s, str)],
+                     dtype=self._dtype)
+        return first
+
+    def _forward_legacy(self, reqs: List[_Request], images, im_info,
+                        tel) -> dict:
+        """PR-3 path: host-prepped batch in, full score/delta readback,
+        host decode + per-class NMS.  Returns the batch's boundary-
+        crossing counter increments (two h2d arrays — images and im_info
+        ship separately into the jit call — one dispatch, one fat
+        readback)."""
+        import jax
+
+        shape = tuple(images.shape)
+        first = self._note_first_dispatch(shape, "serve_predict", tel)
+        t_fwd = time.monotonic()
+        with tel.span("serve/forward"):
+            rois, roi_valid, cls_prob, bbox_deltas, _ = \
+                self.predictor.predict(images, im_info)
+        with tel.span("serve/readback"):
+            rois, roi_valid, cls_prob, bbox_deltas = jax.device_get(
+                (rois, roi_valid, cls_prob, bbox_deltas))
+        if first and self.registry is not None:
+            # first dispatch of a shape = its compile: the forward +
+            # readback wall is the compile(+first run) cost this program
+            # would charge a cold user request
+            self.predictor.record_compile_seconds(
+                shape, time.monotonic() - t_fwd)
+        cfg = self.cfg
+        with tel.span("serve/postprocess"):
+            for b, r in enumerate(reqs):
+                boxes = decode_image_boxes(rois[b], bbox_deltas[b],
+                                           np.asarray(r.im_info))
+                dets_pc = per_class_nms(cls_prob[b], boxes, roi_valid[b],
+                                        cfg.NUM_CLASSES, cfg.TEST.THRESH,
+                                        cfg.TEST.NMS,
+                                        cfg.TEST.MAX_PER_IMAGE)
+                r.future._set_result(detections_to_records(dets_pc))
+        nbytes = int(sum(np.asarray(a).nbytes for a in
+                         (rois, roi_valid, cls_prob, bbox_deltas)))
+        return {"h2d_transfers": 2, "dispatches": 1, "readbacks": 1,
+                "readback_bytes": nbytes}
+
+    def _forward_e2e(self, reqs: List[_Request], staged, im_info,
+                     tel) -> dict:
+        """Single-dispatch path (``--serve-e2e``): ONE ``device_put`` of
+        the staged uint8 batch + its sidecars, ONE fused
+        prep → forward → decode+NMS dispatch (registry kind
+        ``serve_e2e``), ONE readback of the ``(B, cap, 6)`` detections.
+        Responses come from ``device_dets_to_per_class`` — the same
+        top-k-capped contract as ``--device-postprocess`` eval, so exact
+        score ties at the cap may resolve differently from the host-NMS
+        path (documented in ``ops.postprocess.device_postprocess``)."""
+        import jax
+
+        pad = len(staged) - len(reqs)
+        raw_hw = np.stack([np.asarray(r.raw_hw) for r in reqs]
+                          + [np.asarray(reqs[-1].raw_hw)] * pad
+                          ).astype(np.int32)
+        ratio = np.asarray([r.ratio for r in reqs]
+                           + [reqs[-1].ratio] * pad, np.float32)
+        flip = np.zeros(len(staged), bool)  # serve traffic never flips
+        cfg = self.cfg
+        mpi = int(cfg.TEST.MAX_PER_IMAGE)
+        th = float(cfg.TEST.THRESH)
+        shape = tuple(staged.shape) + (f"mpi={mpi}", f"th={th:g}")
+        first = self._note_first_dispatch(shape, "serve_e2e", tel)
+        t_fwd = time.monotonic()
+        with tel.span("serve/h2d"):
+            # the one host→device transfer: a single put of the argument
+            # tuple whose only large buffer is the staged uint8 batch
+            args = jax.device_put((staged, raw_hw, ratio,
+                                   np.asarray(im_info, np.float32), flip))
+        with tel.span("serve/forward"):
+            dets, dvalid = self.predictor.predict_serve_e2e(*args, mpi, th)
+        with tel.span("serve/readback"):
+            dets, dvalid = jax.device_get((dets, dvalid))
+        if first and self.registry is not None:
+            self.predictor.record_compile_seconds(
+                shape, time.monotonic() - t_fwd, kind="serve_e2e")
+        with tel.span("serve/postprocess"):
+            for b, r in enumerate(reqs):
+                dets_pc = device_dets_to_per_class(dets[b], dvalid[b],
+                                                   cfg.NUM_CLASSES)
+                r.future._set_result(detections_to_records(dets_pc))
+        nbytes = int(np.asarray(dets).nbytes + np.asarray(dvalid).nbytes)
+        return {"h2d_transfers": 1, "dispatches": 1, "readbacks": 1,
+                "readback_bytes": nbytes}
 
     # -- introspection ---------------------------------------------------
 

@@ -307,11 +307,31 @@ def canary_probe(engine, predictor) -> tuple:
     """Forward a golden batch at the WARMED landscape bucket shape and
     check every float output is finite — the cheap, recompile-free
     weights-sanity gate a new generation must pass before it serves.
-    Returns (ok, reason)."""
+    Probes the SAME program the engine dispatches — the fused
+    ``serve_e2e`` program when the engine runs single-dispatch mode, the
+    legacy forward otherwise — so the probe never first-dispatches a
+    program warmup didn't register (which would break the
+    ``recompiles_during_swap == 0`` pin).  Returns (ok, reason)."""
     short, long_ = engine._scale
+    B = engine.opts.batch_size
+    if getattr(engine.opts, "serve_e2e", False):
+        from mx_rcnn_tpu.data.image import stage_raw_to_bucket
+
+        cfg = engine.cfg
+        staged, raw_hw, ratio, info = stage_raw_to_bucket(
+            golden_image(short, long_), engine._scale,
+            max(cfg.network.IMAGE_STRIDE, cfg.network.RPN_FEAT_STRIDE))
+        dets, _ = predictor.predict_serve_e2e(
+            np.stack([staged] * B), np.stack([raw_hw] * B),
+            np.asarray([ratio] * B, np.float32),
+            np.stack([info] * B).astype(np.float32),
+            np.zeros(B, bool),
+            int(cfg.TEST.MAX_PER_IMAGE), float(cfg.TEST.THRESH))
+        if not np.isfinite(np.asarray(dets)).all():
+            return False, "non-finite detections on golden image"
+        return True, "ok"
     prepared, im_info = prepare_image(golden_image(short, long_),
                                       engine.cfg, engine._scale)
-    B = engine.opts.batch_size
     images = np.stack([prepared] * B)
     infos = np.stack([im_info] * B)
     out = predictor.predict(images, infos)

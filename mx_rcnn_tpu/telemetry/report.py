@@ -98,6 +98,7 @@ def aggregate(events: Iterable[dict]) -> dict:
     meta: dict = {}
     pipeline: list = []
     eval_pipeline: list = []
+    programs: list = []
     for e in events:
         kind = e.get("kind")
         name = e.get("name")
@@ -143,9 +144,17 @@ def aggregate(events: Iterable[dict]) -> dict:
                 # one row per pred_eval run (eval/pipeline.py overlap
                 # breakdown: device-busy vs host post-process vs idle)
                 eval_pipeline.append(dict(e.get("fields", {})))
+            elif name == "compile/program":
+                # one row per first-dispatched program (compile/
+                # registry.py note_dispatch): kind/shape/dtype/aot — the
+                # registry table below distinguishes fused serve_e2e
+                # programs from legacy predict/device_prep ones
+                programs.append(dict(e.get("fields", {})))
     out_extra = {"pipeline": pipeline} if pipeline else {}
     if eval_pipeline:
         out_extra["eval_pipeline"] = eval_pipeline
+    if programs:
+        out_extra["programs"] = programs
     return {
         "schema": SCHEMA_VERSION,
         "ranks": sorted(ranks),
@@ -251,6 +260,25 @@ def render_table(summary: dict) -> str:
                 f"{row.get('readback_wait_s') or 0.0:>10.3f}"
                 f"{row.get('host_post_s') or 0.0:>9.3f}"
                 f"{100 * (row.get('overlap_frac') or 0.0):>8.1f}%")
+    programs = summary.get("programs", [])
+    if programs:
+        # the program registry table, grouped by (kind, dtype): how many
+        # distinct executables each program family first-dispatched and
+        # how many of them warm-started from the AOT cache — serve_e2e
+        # (fused) vs predict/predict_wf (legacy) vs device_prep read off
+        # separate rows
+        groups: dict = {}
+        for row in programs:
+            key = (str(row.get("kind", "?")), str(row.get("dtype", "?")))
+            g = groups.setdefault(key, [0, 0])
+            g[0] += 1
+            if row.get("aot") == "hit":
+                g[1] += 1
+        lines.append("")
+        lines.append(f"{'program kind':<24}{'dtype':<16}{'programs':>9}"
+                     f"{'aot_hit':>9}")
+        for (kind, dtype), (n, hits) in sorted(groups.items()):
+            lines.append(f"{kind:<24}{dtype:<16}{n:>9}{hits:>9}")
     hists = summary.get("hists", {})
     if hists:
         lines.append("")

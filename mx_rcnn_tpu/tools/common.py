@@ -119,7 +119,7 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
                                  "pad hot path on device as a jitted "
                                  "program (data/device_prep.py; default "
                                  "off = host numpy path, bit-identical to "
-                                 "previous releases; train loaders only)")
+                                 "previous releases)")
         parser.add_argument("--tuned-pipeline", action="store_true",
                             dest="tuned_pipeline",
                             help="boot into the input-pipeline cell "
@@ -137,14 +137,38 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
         parser.add_argument("--thresh", type=float, default=1e-3)
         parser.add_argument("--infer-dtype", default="float32",
                             dest="infer_dtype",
-                            choices=["float32", "bfloat16", "int8"],
+                            choices=["float32", "bfloat16", "int8",
+                                     "int8-activation"],
                             help="inference variant: float32 (exact), "
                                  "bfloat16 (params cast, outputs back to "
                                  "f32 — tolerance-pinned parity vs f32), "
-                                 "or int8 (symmetric weight quantization)."
-                                 "  Each dtype gets its own program-"
-                                 "registry key space and persistent-cache"
-                                 " dir")
+                                 "int8 (symmetric weight quantization), "
+                                 "or int8-activation (weights int8 AND "
+                                 "network-input activations fake-quantized"
+                                 " against scales calibrated with "
+                                 "--calibrate-shard).  Each dtype gets its"
+                                 " own program-registry key space and "
+                                 "persistent-cache dir")
+        parser.add_argument("--calibrate-shard", type=int, default=0,
+                            dest="calibrate_shard", metavar="N",
+                            help="int8-activation calibration: run the "
+                                 "FLOAT model over N held-out images "
+                                 "(tail of the eval set; deterministic "
+                                 "noise under --synthetic), record per-"
+                                 "tensor activation absmax scales, and "
+                                 "persist them next to the AOT marker "
+                                 "manifest in the program cache (0 = use "
+                                 "previously persisted scales, or degrade "
+                                 "to weight-only int8)")
+        parser.add_argument("--device-prep", action="store_true",
+                            dest="device_prep",
+                            help="run eval preprocessing (resize/"
+                                 "normalize/pad) on device as a jitted "
+                                 "program — the loader ships staged raw "
+                                 "uint8 and the Predictor preps it in the "
+                                 "prefetch-thread transfer hook (same "
+                                 "host-bilinear parity pin as train; "
+                                 "single-mesh only — mesh plans raise)")
         parser.add_argument("--program-cache", default="",
                             dest="program_cache", metavar="DIR",
                             help="persistent compiled-program cache base "
@@ -470,3 +494,59 @@ def eval_params_from_args(args, cfg: Config, model):
         params = init_params(model, cfg, jax.random.PRNGKey(0), batch_size=1)
         return denormalize_for_save(params, cfg)
     return load_eval_params(args, cfg, model)
+
+
+def _calibration_images(args, cfg: Config, n: int) -> list:
+    """Raw uint8 HWC images for the activation-calibration shard: the
+    TAIL of the eval image set (held out from nothing the calibration
+    could overfit — scales are absmax statistics, not weights), or
+    deterministic noise frames under ``--synthetic``."""
+    if getattr(args, "synthetic", False):
+        rng = np.random.RandomState(0)
+        h, w = cfg.tpu.SCALES[0]
+        return [rng.randint(0, 256, size=(h, w, 3), dtype=np.uint8)
+                for _ in range(n)]
+    import cv2
+
+    imdb = get_imdb(args, cfg, test=True)
+    roidb = imdb.gt_roidb()
+    imgs = []
+    for rec in roidb[-n:]:
+        im = (rec["image_array"] if "image_array" in rec
+              else cv2.imread(rec["image"], cv2.IMREAD_COLOR))
+        if im is not None:
+            imgs.append(np.ascontiguousarray(im))
+    return imgs
+
+
+def calibrate_from_args(args, cfg: Config, model, params):
+    """``--calibrate-shard N`` under ``--infer-dtype int8-activation``:
+    run the calibration pass (``eval.tester.calibrate_activation_scales``)
+    over the held-out shard, persist the per-tensor scales next to the
+    AOT marker manifest (``ProgramRegistry.save_act_scales``), and return
+    them for the Predictor.  Returns ``None`` when calibration is not
+    requested — the Predictor then auto-loads persisted scales for the
+    same config digest, or degrades to weight-only int8 with a warning."""
+    n = int(getattr(args, "calibrate_shard", 0) or 0)
+    if getattr(args, "infer_dtype", "float32") != "int8-activation":
+        if n > 0:
+            logger.warning("--calibrate-shard only applies to "
+                           "--infer-dtype int8-activation — ignored")
+        return None
+    if n <= 0:
+        return None
+    from mx_rcnn_tpu.compile import ProgramRegistry
+    from mx_rcnn_tpu.eval.tester import calibrate_activation_scales
+
+    tensors = calibrate_activation_scales(
+        model, params, cfg, _calibration_images(args, cfg, n), max_images=n)
+    path = ProgramRegistry(cfg, dtype="int8-activation").save_act_scales(
+        tensors)
+    if path:
+        logger.info("persisted %d activation scale(s) to %s",
+                    len(tensors), path)
+    else:
+        logger.warning("no program cache configured (--program-cache / "
+                       "MXR_PROGRAM_CACHE) — calibrated scales apply to "
+                       "this process only")
+    return tensors

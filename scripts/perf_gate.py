@@ -63,6 +63,13 @@ REPLICA_LINEARITY_FLOOR = 0.85
 # serial loop on the same box (speedup ratio >= 1.0) — a pipeline that
 # loses to serial means the overlap machinery is pure overhead
 EVAL_SPEEDUP_FLOOR = 1.0
+# readback accounting (serve bench): bytes per image crossing device→host
+# is a property of the program contract, not the box — near-zero absolute
+# slack, so a fused path silently regressing to fat readbacks fails even
+# when wall-clock hides it on CPU.  host_prep_ms shares the startup slack
+# (submit-thread timing is scheduler-noisy on a shared CI box).
+READBACK_ABS_SLACK_BYTES = 1024.0
+HOST_PREP_ABS_SLACK_MS = 2.0
 
 
 def slo_report_rows(doc: dict) -> list:
@@ -153,6 +160,18 @@ def startup_rows(rows: list) -> list:
                 out.append({"metric": f"{row.get('metric', '?')}_{field}",
                             "value": v, "unit": "s", "direction": "down",
                             "abs_slack": STARTUP_ABS_SLACK_S})
+        # serve-bench boundary accounting (direction=down like the startup
+        # rows; keyed by the parent metric, so _e2e and unfused rows are
+        # separate series and never score against each other)
+        for field, unit, slack in (
+                ("readback_bytes_per_image", "bytes",
+                 READBACK_ABS_SLACK_BYTES),
+                ("host_prep_ms", "ms", HOST_PREP_ABS_SLACK_MS)):
+            v = row.get(field)
+            if isinstance(v, (int, float)):
+                out.append({"metric": f"{row.get('metric', '?')}_{field}",
+                            "value": v, "unit": unit, "direction": "down",
+                            "abs_slack": slack})
         ev = row.get("eval")
         if isinstance(ev, dict):
             sp = ev.get("speedup_vs_serial")

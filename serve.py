@@ -67,6 +67,16 @@ def parse_args():
                         help="flush a partial batch once its oldest "
                              "request has waited this long; THE latency/"
                              "throughput knob (0 = no coalescing wait)")
+    parser.add_argument("--serve-e2e", action="store_true",
+                        dest="serve_e2e",
+                        help="single-dispatch serving: stage raw uint8 on "
+                             "the caller thread and run device prep + "
+                             "forward + decode/NMS as ONE fused program "
+                             "per (bucket, batch, dtype) — one host→device"
+                             " transfer, one dispatch, one (B, cap, 6) "
+                             "readback per batch.  Off (default) "
+                             "reproduces the classic host-prep path "
+                             "byte-for-byte")
     parser.add_argument("--max-queue", type=int, default=64,
                         dest="max_queue",
                         help="bounded-queue backpressure: submits beyond "
@@ -147,17 +157,23 @@ def _build_engine(args, cfg):
     from mx_rcnn_tpu.eval import Predictor
     from mx_rcnn_tpu.models import build_model
     from mx_rcnn_tpu.serve import ServeEngine, ServeOptions
+    from mx_rcnn_tpu.tools.common import calibrate_from_args
 
     apply_program_cache(args)  # before the Predictor builds its registry
     model = build_model(cfg)
     params = eval_params_from_args(args, cfg, model)
-    predictor = Predictor(model, params, cfg, dtype=args.infer_dtype)
+    # --calibrate-shard: activation scales from the FLOAT params, persisted
+    # next to the AOT markers BEFORE the Predictor quantizes its copy
+    act_scales = calibrate_from_args(args, cfg, model, params)
+    predictor = Predictor(model, params, cfg, dtype=args.infer_dtype,
+                          act_scales=act_scales)
     engine = ServeEngine(predictor, cfg, ServeOptions(
         batch_size=args.serve_batch, max_delay_ms=args.max_delay_ms,
         max_queue=args.max_queue, deadline_ms=args.deadline_ms,
         # the common --loader-workers flag doubles as the serving prep
         # pool size (same data/workers.py pool, image-only tasks)
-        prep_workers=args.loader_workers or 0)).start()
+        prep_workers=args.loader_workers or 0,
+        serve_e2e=getattr(args, "serve_e2e", False))).start()
     return predictor, engine
 
 

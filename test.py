@@ -12,8 +12,8 @@ from mx_rcnn_tpu.eval import Predictor, pred_eval
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.tools.common import (add_common_args, apply_program_cache,
-                                      config_from_args, get_imdb,
-                                      load_eval_params, make_plan,
+                                      calibrate_from_args, config_from_args,
+                                      get_imdb, load_eval_params, make_plan,
                                       start_observability)
 
 
@@ -49,6 +49,16 @@ def parse_args():
 
 def test_rcnn(args):
     cfg = config_from_args(args, train=False)
+    if args.device_postprocess and cfg.network.HAS_MASK \
+            and cfg.TEST.MASK_PASTE == "native":
+        # compact readbacks end to end: the same flag that fuses decode+NMS
+        # moves mask paste onto the device (ops/mask_paste.py) so mask
+        # responses ship packed bitplanes instead of (R, 28, 28) floats.
+        # An explicit --cfg TEST__MASK_PASTE override still wins.
+        import dataclasses
+
+        cfg = cfg.replace(TEST=dataclasses.replace(cfg.TEST,
+                                                   MASK_PASTE="device"))
     apply_program_cache(args)  # before the Predictor builds its registry
     imdb = get_imdb(args, cfg, test=True)
     roidb = imdb.gt_roidb()
@@ -66,8 +76,11 @@ def test_rcnn(args):
         raise ValueError(
             f"--batch_images {bs} must divide by the mesh's data dimension "
             f"{n_data} (the flag is GLOBAL images per step, like train)")
+    # --calibrate-shard (int8-activation only): scales from the FLOAT
+    # params, persisted to the program cache before the variant cast
+    act_scales = calibrate_from_args(args, cfg, model, params)
     predictor = Predictor(model, params, cfg, plan=plan,
-                          dtype=args.infer_dtype)
+                          dtype=args.infer_dtype, act_scales=act_scales)
     # eval is single-process (Predictor enforces it), so rank 0 / world 1
     # and the summary always belongs to this process; the plane owns the
     # sink lifecycle (and the /metrics endpoint when --obs-port is set)
@@ -76,8 +89,12 @@ def test_rcnn(args):
                                         "batch_size": bs},
                               configure_telemetry=True)
     try:
+        # --device-prep: the loader ships staged raw uint8 + sidecars and
+        # the Predictor preps on device in its batch_put hook (mesh plans
+        # raise at Predictor construction — host path only there)
         loader = TestLoader(roidb, cfg, batch_size=bs,
-                            prefetch=args.prefetch)
+                            prefetch=args.prefetch,
+                            device_prep=getattr(args, "device_prep", False))
         stats = pred_eval(predictor, loader, imdb, thresh=args.thresh,
                           vis=args.vis, with_masks=cfg.network.HAS_MASK,
                           det_cache=args.dets_cache or None,

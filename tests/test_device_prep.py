@@ -178,11 +178,48 @@ def test_maybe_device_prep_gating():
 
 
 def test_test_loader_strips_device_prep():
-    """Eval stays on the host path: TestLoader under a DEVICE_PREP config
-    emits fully-prepped float batches, no raw sidecars."""
+    """Eval DEFAULT stays on the host path: TestLoader under a
+    DEVICE_PREP config emits fully-prepped float batches, no raw
+    sidecars, unless the driver opts in per loader
+    (``device_prep=True`` ← test.py ``--device-prep``)."""
     roidb = SyntheticDataset(num_images=2, num_classes=5,
                              height=64, width=96).gt_roidb()
     loader = TestLoader(roidb, tiny_cfg(device_prep=True), batch_size=1)
     batch = next(iter(loader))
     assert "raw_hw" not in batch and "prep_ratio" not in batch
     assert batch["images"].dtype == np.float32
+
+
+def test_eval_device_prep_batch_put_parity():
+    """Eval opt-in (``--device-prep``): TestLoader keeps the staged
+    sidecars and ``Predictor.batch_put`` runs the same jitted prep
+    kernel train uses — batches leave the hook in exactly the host-path
+    layout (float images on device, host-consumed keys still numpy)
+    within the in-bucket parity pin.  Mesh plans keep the explicit
+    ValueError."""
+    from mx_rcnn_tpu.eval import Predictor
+    from mx_rcnn_tpu.models import build_model
+
+    cfg = tiny_cfg(device_prep=True)
+    roidb = SyntheticDataset(num_images=3, num_classes=5,
+                             height=64, width=96).gt_roidb()
+    raw_batches = list(TestLoader(roidb, cfg, batch_size=2,
+                                  device_prep=True))
+    host_batches = list(TestLoader(roidb, tiny_cfg(), batch_size=2))
+    model = build_model(cfg)
+    # params are never applied here: batch_put only exercises the prep
+    # program, so an empty tree keeps the test compile-light
+    pred = Predictor(model, {}, cfg)
+    assert pred._device_prep is not None
+    assert len(raw_batches) == len(host_batches) == 2
+    for raw, host in zip(raw_batches, host_batches):
+        assert raw["images"].dtype == np.uint8 and "raw_hw" in raw
+        out = pred.batch_put(dict(raw))
+        assert "raw_hw" not in out and "prep_ratio" not in out
+        assert isinstance(out["im_info"], np.ndarray)
+        assert isinstance(out["batch_valid"], np.ndarray)
+        np.testing.assert_array_equal(out["im_info"], host["im_info"])
+        np.testing.assert_allclose(np.asarray(out["images"]),
+                                   host["images"], atol=1e-5, rtol=0)
+    with pytest.raises(ValueError, match="mesh plan"):
+        Predictor(model, {}, cfg, plan=object())

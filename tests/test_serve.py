@@ -317,3 +317,92 @@ def test_serve_e2e_unix_socket_warm_and_parity(tmp_path):
         server.server_close()
         engine.stop()
         telemetry.shutdown()
+
+
+def test_serve_e2e_fused_single_dispatch_contract():
+    """``--serve-e2e`` acceptance: warmup registers kind-labeled fused
+    programs (one per orientation), a request batch crosses the host↔device
+    boundary exactly once in each direction (1 h2d / 1 dispatch /
+    1 readback — counter assert), the detection readback is a fraction of
+    the legacy fat path's, a hot param swap costs zero recompiles, and
+    fused detections match the unfused engine's records at float
+    tolerance (exact score ties at the MAX_PER_IMAGE cap may resolve
+    differently — the documented device-postprocess divergence)."""
+    import jax
+
+    from mx_rcnn_tpu.eval import Predictor
+    from mx_rcnn_tpu.models import build_model, init_params
+    from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
+
+    cfg = tiny_cfg()
+    model = build_model(cfg)
+    params = denormalize_for_save(
+        init_params(model, cfg, jax.random.PRNGKey(0), 2, (96, 128)), cfg)
+    pred = Predictor(model, params, cfg)
+
+    rng = np.random.RandomState(11)
+    land_a = rng.randint(0, 255, (60, 100, 3), dtype=np.uint8)
+    land_b = rng.randint(0, 255, (48, 90, 3), dtype=np.uint8)
+    port = rng.randint(0, 255, (100, 60, 3), dtype=np.uint8)
+    images = [land_a, land_b, port]
+
+    # unfused reference on the SAME predictor/registry: the legacy and
+    # fused kinds coexist in one program key space
+    legacy = ServeEngine(pred, cfg, ServeOptions(
+        batch_size=2, max_delay_ms=5.0, max_queue=16)).start()
+    try:
+        expect = [legacy.submit(img).result(timeout=300) for img in images]
+        lc = dict(legacy.counters)
+    finally:
+        legacy.stop()
+    assert lc["h2d_transfers"] == 2 * lc["batches"]  # images + im_info
+    legacy_readback_per_batch = lc["readback_bytes"] / lc["batches"]
+
+    engine = ServeEngine(pred, cfg, ServeOptions(
+        batch_size=2, max_delay_ms=200.0, max_queue=16,
+        serve_e2e=True)).start()
+    try:
+        assert warmup(engine) == 2  # one fused program per orientation
+        # /metrics compile snapshot labels programs by kind: the fused
+        # programs are distinguishable from the legacy forwards
+        rows = engine.metrics()["compile"]["programs"]
+        kinds = {p["kind"] for p in rows}
+        assert "serve_e2e" in kinds and "predict" in kinds
+        assert sum(p["kind"] == "serve_e2e" for p in rows) == 2
+
+        # one full batch = exactly one transfer/dispatch/readback
+        base = dict(engine.counters)
+        futs = [engine.submit(img) for img in (land_a, land_b)]
+        got = [f.result(timeout=300) for f in futs]
+        delta = {k: engine.counters[k] - base[k]
+                 for k in ("h2d_transfers", "dispatches", "readbacks",
+                           "batches")}
+        assert delta == {"h2d_transfers": 1, "dispatches": 1,
+                         "readbacks": 1, "batches": 1}
+        # the (B, cap, 6) readback is far below the legacy scores+deltas
+        e2e_readback = engine.counters["readback_bytes"] - \
+            base["readback_bytes"]
+        assert 0 < e2e_readback < legacy_readback_per_batch
+        got.append(engine.submit(port).result(timeout=300))
+
+        # fused vs unfused detection-record parity at float tolerance
+        for dets, ref in zip(got, expect):
+            assert len(dets) == len(ref)
+            for d, e in zip(dets, ref):
+                assert d["cls"] == e["cls"]
+                assert abs(d["score"] - e["score"]) < 0.02
+                assert np.allclose(d["bbox"], e["bbox"], atol=1.0)
+
+        # hot-reload param swap: zero recompiles under the fused kind,
+        # identical detections (same weights back in)
+        before = engine.counters["recompiles"]
+        pred.update_params(params)
+        again = engine.submit(land_a).result(timeout=300)
+        assert engine.counters["recompiles"] == before == \
+            engine.counters["warmup_programs"]
+        assert len(again) == len(got[0])
+        for d, e in zip(again, got[0]):
+            assert d["cls"] == e["cls"]
+            assert abs(d["score"] - e["score"]) < 1e-5
+    finally:
+        engine.stop()
