@@ -20,6 +20,13 @@ jitted bucket programs, and the shared ``ops/postprocess`` block that
 * ``supervisor`` — the parent-side: liveness/readiness probing, crash/
   hang detection, backoff respawn with a systemic limit, rolling
   reloads, and the retry-budgeted request router.
+* ``fabric``     — the cross-host generalization: a transport-agnostic
+  replica pool (local fork children + remote TCP members that ``--join``
+  or are registered by address), HTTP-probe-driven membership with
+  eviction/re-admission instead of respawn, least-loaded routing over
+  freshness-checked queue-depth gauges, per-member circuit breakers,
+  request hedging, partition-tolerant degraded serving, and rolling
+  hot-reload across remote members.
 
 Driver: top-level ``serve.py`` (``--replicas N`` for the plane);
 load generator: ``scripts/loadgen.py``; throughput: ``bench.py --mode
@@ -30,11 +37,19 @@ serve``; smoke: ``script/serve_smoke.sh``, ``script/slo_smoke.sh``, and
 from mx_rcnn_tpu.serve.controller import ControllerOptions, SLOController
 from mx_rcnn_tpu.serve.engine import (DeadlineExceededError, RejectedError,
                                       ServeEngine, ServeFuture, ServeOptions)
-from mx_rcnn_tpu.serve.frontend import (encode_image_payload, make_server,
-                                        run_stdio, unix_http_request,
+from mx_rcnn_tpu.serve.fabric import (CircuitBreaker, FabricOptions,
+                                      FabricRouter, LocalMember, RemoteMember,
+                                      ReplicaPool, make_fabric_server,
+                                      normalize_address, register_with_router)
+from mx_rcnn_tpu.serve.frontend import (address_request, address_request_raw,
+                                        encode_image_payload, make_server,
+                                        parse_address, run_stdio,
+                                        tcp_http_request, tcp_http_request_raw,
+                                        unix_http_request,
                                         unix_http_request_raw)
-from mx_rcnn_tpu.serve.replica import (CheckpointWatcher, ReplicaFaults,
-                                       make_reloader, reload_engine_params,
+from mx_rcnn_tpu.serve.replica import (CheckpointWatcher, NetFaults,
+                                       ReplicaFaults, make_reloader,
+                                       reload_engine_params,
                                        scan_checkpoints, serve_replica)
 from mx_rcnn_tpu.serve.supervisor import (ReplicaRouter, ReplicaSpec,
                                           ReplicaSupervisor,
@@ -49,4 +64,9 @@ __all__ = ["ServeEngine", "ServeOptions", "ServeFuture", "RejectedError",
            "CheckpointWatcher", "ReplicaFaults", "make_reloader",
            "reload_engine_params", "scan_checkpoints", "serve_replica",
            "ReplicaRouter", "ReplicaSpec", "ReplicaSupervisor",
-           "SupervisorOptions", "make_router_server", "replica_specs"]
+           "SupervisorOptions", "make_router_server", "replica_specs",
+           "CircuitBreaker", "FabricOptions", "FabricRouter", "LocalMember",
+           "RemoteMember", "ReplicaPool", "make_fabric_server",
+           "normalize_address", "register_with_router", "NetFaults",
+           "parse_address", "address_request", "address_request_raw",
+           "tcp_http_request", "tcp_http_request_raw"]

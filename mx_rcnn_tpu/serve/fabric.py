@@ -1,0 +1,1088 @@
+"""Cross-host serving fabric: remote replica pools over the PR-8
+contract (ISSUE 12 tentpole; ROADMAP item 2).
+
+PR-8's supervisor/router is single-host by construction: liveness is
+``waitpid``, transport is a Unix socket, recovery is fork respawn.  This
+module keeps that robustness contract but makes the router/replica
+relationship **transport-agnostic**:
+
+    clients → fabric router (this module, ``serve.py --fabric``)
+                ├── remote member "hostA:8001"   (joined via --join)
+                ├── remote member "hostB:8001"   (from --pool-file)
+                └── local members (fork children, when --replicas N > 1,
+                    still owned by the PR-8 ReplicaSupervisor)
+
+* **Membership is probe-driven, not waitpid-driven.**  A remote member
+  is whatever answers ``/readyz`` at its address.  The PR-8 state
+  machine carries over with one deliberate amputation: the fabric has
+  *no respawn authority* over a remote host.  A crash looks like probe
+  failure → the member is **evicted** (unrouted + flight-dumped), then
+  re-probed on the same exponential backoff schedule, and **re-admitted**
+  the moment ``/readyz`` answers 200 again.  The systemic limit becomes
+  quarantine: a member that fails ``max_failures`` consecutive contact
+  cycles stops being probed until an explicit ``/admin/register``.
+* **Least-loaded routing** over each member's live ``queue_depth``
+  gauge, sampled by the readiness probe and **timestamped at receipt**
+  (the router's clock — remote clocks are never trusted).  Samples older
+  than ``stale_probe_intervals × probe_interval_s`` are ignored and the
+  router falls back to PR-8 round-robin: a stale gauge must never pin
+  traffic on yesterday's idlest member.
+* **Retry-once-on-alternate** under the PR-8 :class:`TokenBucket`
+  budget, unchanged semantics: transport error or 503 retries once on a
+  different member; budget exhausted → early 503.
+* **Per-member circuit breakers** — consecutive data-path failures open
+  the breaker (member unpicked), a cooldown later one half-open trial
+  request probes it, success closes.  This is the data-path complement
+  to membership probes: a member whose ``/readyz`` is healthy but whose
+  ``/predict`` resets connections is exactly what breakers are for.
+* **Request hedging** (``hedge_after_ms > 0``): a request still
+  unanswered after the threshold is duplicated to a second member and
+  the first 2xx wins.  Hedges are counted distinctly from retries
+  (``hedge_fired`` / ``hedge_won``) — a hedge is a latency bet, a retry
+  is a failure response.
+* **Partition tolerance** — the router keeps serving whatever subset it
+  can reach; when the ready fraction drops below ``partition_floor`` it
+  flight-dumps ``fabric_partition`` once per transition and raises the
+  ``fabric/partition`` counter.  Recovery clears the flag.
+* **Rolling hot reload** across members through the same
+  unroute → drain → ``POST /admin/reload`` → canary → re-ready sequence
+  as PR-8, now per-address instead of per-fork-child, with the identical
+  rollback-on-canary-rejection and monotonic-generation rules.
+
+``poll(now=None)`` stays the injectable-clock test surface, and
+``probe_fn`` / ``reload_fn`` / ``forward_fn`` stay injectable — the
+chaos tests drive the whole fabric deterministically, then the e2e suite
+re-runs the same scenarios over real localhost TCP subprocesses.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.serve.frontend import (_Handler, _TCPHTTPServer,
+                                        _UnixHTTPServer, address_request,
+                                        address_request_raw)
+from mx_rcnn_tpu.serve.supervisor import (FAILED, READY as SUP_READY,
+                                          STOPPED, ReplicaSupervisor,
+                                          TokenBucket)
+from mx_rcnn_tpu.telemetry.obs import PROM_CONTENT_TYPE, prometheus_text
+
+# remote-member states — the PR-8 replica states with respawn authority
+# amputated: a fabric can only evict and re-admit, never fork
+JOINING = "joining"          # registered; first successful probe pending
+MEMBER_READY = "ready"       # /readyz 200 — routable unless mid-reload
+EVICTED = "evicted"          # unreachable; re-probed on backoff
+QUARANTINED = "quarantined"  # systemic: probing stopped until re-register
+
+
+@dataclass(frozen=True)
+class FabricOptions:
+    probe_interval_s: float = 1.0    # membership poll period
+    probe_timeout_s: float = 5.0     # one readiness probe's HTTP timeout
+    evict_probes: int = 3            # consecutive misses on a READY member
+    start_timeout_s: float = 600.0   # register → first 200 ceiling
+    backoff_base_s: float = 0.5      # first re-probe delay after eviction
+    backoff_max_s: float = 30.0      # re-probe backoff ceiling
+    max_failures: int = 16           # consecutive failed contact cycles
+    stable_s: float = 60.0           # ready this long forgives the history
+    stale_probe_intervals: float = 2.0  # queue_depth sample TTL multiplier
+    partition_floor: float = 0.5     # ready fraction below this = partition
+    hedge_after_ms: float = 0.0      # 0 disables hedging
+    breaker_failures: int = 3        # consecutive data-path failures → open
+    breaker_cooldown_s: float = 5.0  # open → half-open trial delay
+    retry_budget: int = 16           # PR-8 retry TokenBucket, unchanged
+    retry_refill_per_s: float = 4.0
+    drain_timeout_s: float = 30.0    # router-side in-flight wait (reload)
+    reload_timeout_s: float = 120.0  # one member's /admin/reload ceiling
+    forward_timeout_s: float = 600.0
+
+    @property
+    def stale_after_s(self) -> float:
+        """A queue_depth sample older than this is routing-inert."""
+        return self.stale_probe_intervals * self.probe_interval_s
+
+
+class CircuitBreaker:
+    """Per-member data-path breaker: ``threshold`` consecutive failures
+    open it; after ``cooldown_s`` exactly one half-open trial is allowed
+    through — success closes, failure re-opens.  ``now`` is injectable
+    everywhere (the fabric's fake-clock test discipline)."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 5.0):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.state = self.CLOSED
+        self.failures = 0
+        self.open_until = 0.0
+        self._trial = False
+        self._lock = threading.Lock()
+
+    def allow(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                if now >= self.open_until:
+                    self.state = self.HALF_OPEN
+                    self._trial = True
+                    return True  # the single trial request
+                return False
+            # HALF_OPEN with the trial already in flight: hold the line
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self.state = self.CLOSED
+            self.failures = 0
+            self._trial = False
+
+    def record_failure(self, now: Optional[float] = None) -> bool:
+        """Returns True when THIS failure opened the breaker (the caller
+        counts ``breaker_open`` exactly once per transition)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self.failures += 1
+            if (self.state == self.HALF_OPEN
+                    or (self.state == self.CLOSED
+                        and self.failures >= self.threshold)):
+                opened = self.state != self.OPEN
+                self.state = self.OPEN
+                self.open_until = now + self.cooldown_s
+                self._trial = False
+                return opened
+            return False
+
+
+class RemoteMember:
+    """One remote replica known by address — ``host:port`` for TCP or a
+    filesystem path (``unix:`` prefix accepted) for same-host members.
+    All mutable supervision state lives here; the pool mutates it under
+    its lock, HTTP happens outside."""
+
+    kind = "remote"
+
+    def __init__(self, address: str, opts: FabricOptions):
+        self.address = normalize_address(address)
+        self.name = self.address
+        self.state = JOINING
+        self.routable = False
+        self.reloading = False
+        self.generation = 0
+        self.inflight = 0
+        self.requests = 0         # forward attempts routed here
+        self.evictions = 0
+        self.failures = 0         # consecutive failed contact cycles
+        self.probe_fails = 0      # consecutive misses while READY
+        self.depth = None         # last queue_depth sample ...
+        self.depth_t = None       # ... and WHEN the router received it
+        self.joined_t = 0.0
+        self.ready_t = 0.0
+        self.next_probe_t = 0.0   # eviction backoff schedule
+        self.last_reload = None   # last /admin/reload response doc
+        self.breaker = CircuitBreaker(opts.breaker_failures,
+                                      opts.breaker_cooldown_s)
+
+    def is_active(self) -> bool:
+        return self.state != QUARANTINED
+
+    def is_ready(self) -> bool:
+        return self.state == MEMBER_READY
+
+    def http_raw(self, method, path, body=None, timeout=60.0):
+        return address_request_raw(self.address, method, path, body=body,
+                                   timeout=timeout)
+
+    def http(self, method, path, doc=None, timeout=60.0):
+        return address_request(self.address, method, path, doc=doc,
+                               timeout=timeout)
+
+
+class LocalMember:
+    """A fork-child replica wrapped to the member surface.  The PR-8
+    supervisor KEEPS full authority — spawn, waitpid, hang-kill, backoff,
+    systemic limit; the pool only reads its state, samples its
+    queue_depth, and borrows its routable/reloading/inflight flags so
+    routing and rolling reloads treat both member kinds identically."""
+
+    kind = "local"
+
+    def __init__(self, handle, sup: ReplicaSupervisor,
+                 opts: FabricOptions):
+        self.handle = handle
+        self.sup = sup
+        self.name = f"local/{handle.index}"
+        self.address = f"unix:{handle.spec.sock}"
+        self.requests = 0
+        self.evictions = 0
+        self.depth = None
+        self.depth_t = None
+        self.last_reload = None
+        self.breaker = CircuitBreaker(opts.breaker_failures,
+                                      opts.breaker_cooldown_s)
+
+    # supervision state is the handle's — shared, not copied
+    @property
+    def state(self):
+        return self.handle.state
+
+    @property
+    def routable(self):
+        return self.handle.routable
+
+    @routable.setter
+    def routable(self, v):
+        self.handle.routable = bool(v)
+
+    @property
+    def reloading(self):
+        return self.handle.reloading
+
+    @reloading.setter
+    def reloading(self, v):
+        self.handle.reloading = bool(v)
+
+    @property
+    def inflight(self):
+        return self.handle.inflight
+
+    @inflight.setter
+    def inflight(self, v):
+        self.handle.inflight = v
+
+    @property
+    def generation(self):
+        return self.handle.generation
+
+    @generation.setter
+    def generation(self, v):
+        self.handle.generation = v
+
+    @property
+    def probe_fails(self):
+        return self.handle.probe_fails
+
+    def is_active(self) -> bool:
+        return self.state not in (FAILED, STOPPED)
+
+    def is_ready(self) -> bool:
+        return self.state == SUP_READY
+
+    def http_raw(self, method, path, body=None, timeout=60.0):
+        return address_request_raw(self.address, method, path, body=body,
+                                   timeout=timeout)
+
+    def http(self, method, path, doc=None, timeout=60.0):
+        return address_request(self.address, method, path, doc=doc,
+                               timeout=timeout)
+
+
+def normalize_address(address: str) -> str:
+    """Canonical member key: ``host:port`` for TCP, ``unix:<path>`` for
+    sockets — so ``/admin/register`` dedupes no matter how the address
+    was spelled."""
+    address = address.strip()
+    if address.startswith("unix:"):
+        return "unix:" + address[5:]
+    if "/" in address:
+        return "unix:" + address
+    host, _, port = address.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"member address must be HOST:PORT or a unix "
+                         f"socket path, got {address!r}")
+    return f"{host}:{int(port)}"
+
+
+class ReplicaPool:
+    """Probe-driven membership over local and remote members.  Remote
+    members arrive via :meth:`register` (``/admin/register`` /
+    ``--join``) or :meth:`load_pool_file`; local fork children via
+    :meth:`adopt_supervisor`.  ``poll(now=None)`` is one membership step
+    — tests drive it with a fake clock, production wraps it in the
+    monitor thread (:meth:`start`)."""
+
+    def __init__(self, opts: Optional[FabricOptions] = None,
+                 probe_fn: Optional[Callable] = None,
+                 reload_fn: Optional[Callable] = None):
+        self.opts = opts or FabricOptions()
+        self.members: Dict[str, object] = {}  # name → member (ordered)
+        self._probe_fn = probe_fn or self._default_probe
+        self._reload_fn = reload_fn or self._default_reload
+        self._lock = threading.Lock()
+        self._gen_lock = threading.Lock()
+        self._roll_lock = threading.Lock()  # one rolling reload at a time
+        self.generation = 0
+        self._target: Optional[dict] = None
+        self._prev_target: Optional[dict] = None
+        self.partition = False
+        self._ever_ready = False  # gates partition alarms until first join
+        self.sup: Optional[ReplicaSupervisor] = None
+        self.counters = {"member_joined": 0, "member_evicted": 0,
+                         "member_quarantined": 0, "partition": 0,
+                         "reload": 0, "reload_rollback": 0,
+                         "breaker_open": 0, "hedge_fired": 0,
+                         "hedge_won": 0, "retry": 0, "retry_ok": 0,
+                         "retry_budget_exhausted": 0, "no_ready": 0,
+                         "transport_error": 0, "requests": 0}
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def count(self, key: str, inc: int = 1):
+        """Pool counter + the matching ``fabric/*`` telemetry counter —
+        one source for the JSON view, the report table, and Prometheus."""
+        self.counters[key] = self.counters.get(key, 0) + inc
+        telemetry.get().counter(f"fabric/{key}", inc)
+
+    # -- membership ------------------------------------------------------
+
+    def register(self, address: str,
+                 now: Optional[float] = None) -> Tuple[object, bool]:
+        """Admit (or re-admit) a remote member by address.  Explicit
+        registration is the quarantine escape hatch: it resets the
+        failure history and schedules an immediate probe."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            key = normalize_address(address)
+            m = self.members.get(key)
+            created = m is None
+            if created:
+                m = RemoteMember(key, self.opts)
+                m.joined_t = now
+                self.members[m.name] = m
+                logger.info("fabric: member %s registered", m.name)
+            elif getattr(m, "kind", "remote") == "remote" \
+                    and m.state in (EVICTED, QUARANTINED):
+                m.state = JOINING
+                m.failures = 0
+                m.probe_fails = 0
+                m.next_probe_t = 0.0
+                m.joined_t = now
+                logger.info("fabric: member %s re-registered (was %s)",
+                            m.name, EVICTED)
+        self._wake.set()
+        return m, created
+
+    def load_pool_file(self, path: str) -> int:
+        """Seed membership from a pool file: one address per line,
+        ``#`` comments and blank lines ignored."""
+        n = 0
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if line:
+                    self.register(line)
+                    n += 1
+        return n
+
+    def adopt_supervisor(self, sup: ReplicaSupervisor):
+        """Wrap every fork-child handle as a LocalMember.  The
+        supervisor keeps respawn authority; the pool handles routing."""
+        self.sup = sup
+        with self._lock:
+            for h in sup.handles:
+                m = LocalMember(h, sup, self.opts)
+                self.members[m.name] = m
+
+    # -- default probing/reload wiring -----------------------------------
+
+    def _default_probe(self, member, path: str):
+        return member.http("GET", path, timeout=self.opts.probe_timeout_s)
+
+    def _default_reload(self, member, target: dict):
+        return member.http("POST", "/admin/reload", doc=target,
+                           timeout=self.opts.reload_timeout_s)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "ReplicaPool":
+        assert self._thread is None, "pool already started"
+
+        def monitor():
+            while not self._stop.is_set():
+                self._wake.wait(self.opts.probe_interval_s)
+                self._wake.clear()
+                if self._stop.is_set():
+                    return
+                try:
+                    self.poll()
+                except Exception:  # noqa: BLE001 — membership must survive
+                    logger.exception("fabric poll failed")
+
+        self._thread = threading.Thread(target=monitor,
+                                        name="fabric-pool", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    # -- the membership state machine ------------------------------------
+
+    def poll(self, now: Optional[float] = None):
+        """One membership step over every member.  Probe I/O runs
+        outside the lock; state transitions inside it."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            members = list(self.members.values())
+        for m in members:
+            if m.kind == "local":
+                self._poll_local(m, now)
+            else:
+                self._poll_remote(m, now)
+        self._update_partition(now)
+        tel = telemetry.get()
+        tel.gauge("fabric/ready", self.ready_count())
+        tel.gauge("fabric/members", len(members))
+        tel.gauge("fabric/generation", self.generation)
+
+    def _poll_local(self, m: LocalMember, now: float):
+        # liveness/respawn is the supervisor's; the pool only keeps the
+        # queue_depth gauge fresh for least-loaded routing
+        if not (m.routable and not m.reloading):
+            return
+        try:
+            _, doc = self._probe_fn(m, "/readyz")
+        except Exception:  # noqa: BLE001 — supervisor will catch the hang
+            return
+        if isinstance(doc, dict) and "queue_depth" in doc:
+            with self._lock:
+                m.depth = doc["queue_depth"]
+                m.depth_t = now
+
+    def _poll_remote(self, m: RemoteMember, now: float):
+        if m.state == QUARANTINED:
+            return
+        if m.state == EVICTED and now < m.next_probe_t:
+            return
+        kind, payload = self._try_probe(m)
+        if kind in ("up", "unready") and isinstance(payload, dict) \
+                and "queue_depth" in payload:
+            # timestamped at RECEIPT with the router's clock — remote
+            # timestamps would need cross-host clock trust we don't have
+            with self._lock:
+                m.depth = payload["queue_depth"]
+                m.depth_t = now
+        if kind == "up":
+            self._on_member_up(m, payload, now)
+        elif kind == "unready":
+            # alive but warming/draining: never an eviction signal, but
+            # not routable either (the replica itself said not-ready)
+            with self._lock:
+                m.probe_fails = 0
+                if m.state == MEMBER_READY and not m.reloading:
+                    m.routable = False
+        else:
+            self._on_member_down(m, payload, now)
+
+    def _try_probe(self, m) -> Tuple[str, object]:
+        try:
+            status, doc = self._probe_fn(m, "/readyz")
+        except Exception as e:  # noqa: BLE001 — unreachable = down
+            return "down", f"{type(e).__name__}: {e}"
+        if status == 200 and isinstance(doc, dict):
+            return "up", doc
+        if status == 503 and isinstance(doc, dict):
+            return "unready", doc
+        return "down", f"status {status}"
+
+    def _on_member_up(self, m: RemoteMember, doc: dict, now: float):
+        catch_up = None
+        with self._lock:
+            m.probe_fails = 0
+            if m.state != MEMBER_READY:
+                was = m.state
+                m.state = MEMBER_READY
+                m.ready_t = now
+                m.routable = not m.reloading
+                # trust the member's own generation: a restarted process
+                # reports its boot weights, which drives catch-up below
+                m.generation = int(doc.get("generation", 0) or 0)
+                joined = True
+                readmitted = was == EVICTED
+            else:
+                joined = False
+                readmitted = False
+                if m.failures and now - m.ready_t > self.opts.stable_s:
+                    m.failures = 0  # stable long enough: forgiven
+                if not m.routable and not m.reloading:
+                    m.routable = True  # suspect cleared by probe
+            if joined:
+                target = self._target
+                if target is not None and m.generation < self.generation:
+                    catch_up = dict(target, generation=self.generation)
+        if joined:
+            self.count("member_joined")
+            logger.info("fabric: member %s %s (generation %d)", m.name,
+                        "re-admitted" if readmitted else "joined",
+                        m.generation)
+            if catch_up is not None:
+                # a re-admitted member restarted on stale weights — catch
+                # it up before clients can see yesterday's boxes
+                self._reload_one(m, catch_up)
+
+    def _on_member_down(self, m: RemoteMember, cause, now: float):
+        with self._lock:
+            m.probe_fails += 1
+            fails = m.probe_fails
+            state = m.state
+        if state == MEMBER_READY:
+            if fails >= self.opts.evict_probes:
+                self._evict(m, now, f"unreachable ({fails} probe "
+                                    f"failures: {cause})")
+            else:
+                with self._lock:
+                    m.routable = False  # suspect until a probe clears it
+        elif state == JOINING:
+            if now - m.joined_t > self.opts.start_timeout_s:
+                self._evict(m, now, "join timeout")
+        elif state == EVICTED:
+            with self._lock:
+                m.failures += 1
+            self._schedule_reprobe(m, now)
+
+    def _evict(self, m: RemoteMember, now: float, reason: str):
+        with self._lock:
+            m.state = EVICTED
+            m.routable = False
+            m.probe_fails = 0
+            m.failures += 1
+            m.evictions += 1
+            m.depth_t = None  # its gauge is history, not data
+        self.count("member_evicted")
+        telemetry.get().dump_flight("member_evicted", member=m.name,
+                                    cause=reason, evictions=m.evictions)
+        logger.warning("fabric: member %s evicted (%s) — re-probing on "
+                       "backoff (no respawn authority over a remote "
+                       "host: eviction and re-admission are all the "
+                       "fabric can do)", m.name, reason)
+        self._schedule_reprobe(m, now)
+
+    def _schedule_reprobe(self, m: RemoteMember, now: float):
+        with self._lock:
+            failures = m.failures
+        if failures > self.opts.max_failures:
+            with self._lock:
+                m.state = QUARANTINED
+            self.count("member_quarantined")
+            telemetry.get().dump_flight("member_quarantined",
+                                        member=m.name, failures=failures)
+            logger.error("fabric: member %s quarantined after %d failed "
+                         "contact cycles — not probing again until it "
+                         "re-registers (the PR-4/PR-8 systemic-limit "
+                         "contract, minus the authority to respawn)",
+                         m.name, failures)
+            return
+        delay = min(self.opts.backoff_base_s * (2.0 ** (failures - 1)),
+                    self.opts.backoff_max_s)
+        with self._lock:
+            m.next_probe_t = now + delay
+
+    def note_suspect(self, m):
+        """Router feedback: a forward failed at the transport level.
+        Unroute immediately; the next probe confirms or clears."""
+        if m.kind == "local" and self.sup is not None:
+            self.sup.note_suspect(m.handle)
+        else:
+            with self._lock:
+                if m.state == MEMBER_READY:
+                    m.routable = False
+                    m.probe_fails = max(m.probe_fails, 1)
+        self._wake.set()
+
+    def _update_partition(self, now: float):
+        with self._lock:
+            members = list(self.members.values())
+        if not members:
+            return
+        active = [m for m in members if m.is_active()]
+        ready = sum(1 for m in members if m.routable and not m.reloading)
+        if ready > 0:
+            self._ever_ready = True
+        if not self._ever_ready:
+            return  # a pool that never formed is a boot, not a partition
+        frac = ready / max(1, len(active))
+        if frac < self.opts.partition_floor:
+            if not self.partition:
+                self.partition = True
+                self.count("partition")
+                telemetry.get().dump_flight(
+                    "fabric_partition", ready=ready, active=len(active),
+                    members=len(members), fraction=round(frac, 3))
+                logger.error("fabric: PARTITION — %d/%d members "
+                             "reachable (floor %.2f); serving the "
+                             "reachable subset", ready, len(active),
+                             self.opts.partition_floor)
+        elif self.partition:
+            self.partition = False
+            logger.info("fabric: partition healed — %d/%d members "
+                        "reachable", ready, len(active))
+
+    # -- routing support -------------------------------------------------
+
+    def routable_members(self) -> List[object]:
+        with self._lock:
+            return [m for m in self.members.values()
+                    if m.routable and not m.reloading]
+
+    def ready_count(self) -> int:
+        return len(self.routable_members())
+
+    # -- rolling hot reload ----------------------------------------------
+
+    def _wait_inflight_drained(self, m) -> bool:
+        deadline = time.monotonic() + self.opts.drain_timeout_s
+        while m.inflight > 0:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def _reload_one(self, m, target: dict) -> bool:
+        """Unroute → wait router in-flight → swap → re-route: the PR-8
+        sequence verbatim, addressed to the member's transport."""
+        with self._lock:
+            m.routable = False
+            m.reloading = True
+        try:
+            self._wait_inflight_drained(m)
+            try:
+                status, doc = self._reload_fn(m, target)
+            except Exception as e:  # noqa: BLE001 — treat as rejection
+                status, doc = 0, {"error": f"{type(e).__name__}: {e}"}
+            if status == 200:
+                with self._lock:
+                    m.generation = int(target.get("generation",
+                                                  m.generation))
+                    m.last_reload = doc if isinstance(doc, dict) else {}
+                self.count("reload")
+                logger.info("fabric: member %s generation %s live "
+                            "(%s recompiles during swap)", m.name,
+                            doc.get("generation"),
+                            doc.get("recompiles_during_swap"))
+                return True
+            logger.error("fabric: member %s reload rejected (%s): %s",
+                         m.name, status,
+                         doc.get("error", doc) if isinstance(doc, dict)
+                         else doc)
+            return False
+        finally:
+            with self._lock:
+                m.reloading = False
+                if m.is_ready():
+                    m.routable = True
+
+    def reload_to(self, target: dict) -> bool:
+        """Roll ``target`` through every ready member one at a time —
+        the reachable subset keeps serving throughout.  Mid-roll canary
+        rejection aborts and rolls already-swapped members back to the
+        previous target; the pool generation is monotonic and only
+        advances on a fully-rolled fabric."""
+        with self._roll_lock:
+            with self._gen_lock:
+                gen = self.generation + 1
+            target = dict(target, generation=gen)
+            swapped: List[object] = []
+            victims = [m for m in self.members.values() if m.is_ready()]
+            if not victims:
+                logger.warning("fabric reload_to: no ready members")
+                return False
+            for m in victims:
+                if not m.is_ready():
+                    continue  # evicted mid-roll; catch-up on re-admission
+                if self._reload_one(m, target):
+                    swapped.append(m)
+                    continue
+                self.count("reload_rollback")
+                telemetry.get().dump_flight("reload_roll_aborted",
+                                            member=m.name, generation=gen)
+                prev = self._target
+                if prev is not None:
+                    back = dict(prev, generation=self.generation)
+                    for ms in swapped:
+                        self._reload_one(ms, back)
+                elif swapped:
+                    logger.error(
+                        "fabric reload_to: generation %d rejected on %s "
+                        "AFTER %d member(s) swapped with no prior target "
+                        "to roll back to — fabric is mixed until the "
+                        "next good save", gen, m.name, len(swapped))
+                return False
+            with self._gen_lock:
+                self.generation = max(self.generation, gen)
+            self._prev_target, self._target = self._target, target
+            # anyone who joined or re-admitted mid-roll missed the list
+            for m in self.members.values():
+                if m.is_ready() and m.generation < gen:
+                    self._reload_one(m, target)
+            telemetry.get().gauge("fabric/generation", self.generation)
+            logger.info("fabric rolling reload complete: generation %d "
+                        "live on %d member(s)", self.generation,
+                        len(swapped))
+            return True
+
+    # -- introspection ---------------------------------------------------
+
+    def metrics(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            members = {}
+            for m in self.members.values():
+                age = (None if m.depth_t is None
+                       else round(now - m.depth_t, 3))
+                members[m.name] = {
+                    "kind": m.kind, "address": m.address,
+                    "state": m.state, "routable": m.routable,
+                    "generation": m.generation, "inflight": m.inflight,
+                    "requests": m.requests, "evictions": m.evictions,
+                    "probe_fails": m.probe_fails,
+                    "breaker": m.breaker.state,
+                    "queue_depth": m.depth,
+                    # the stale-gauge contract made visible: operators
+                    # (and loadgen) see exactly what least-loaded sees
+                    "queue_depth_age_s": age,
+                    "queue_depth_stale": (m.depth_t is None or
+                                          now - m.depth_t
+                                          > self.opts.stale_after_s),
+                }
+        return {"generation": self.generation,
+                "ready": self.ready_count(),
+                "members": members,
+                "partition": self.partition,
+                "counters": dict(self.counters)}
+
+
+class FabricRouter:
+    """Least-loaded request router over the pool's routable members with
+    the PR-8 retry-once budget and optional hedging.  ``forward_fn(
+    member, method, path, body, timeout) → (status, bytes, ctype)`` is
+    injectable for tests."""
+
+    def __init__(self, pool: ReplicaPool, forward_fn=None,
+                 timeout_s: Optional[float] = None):
+        self.pool = pool
+        self.timeout_s = (pool.opts.forward_timeout_s
+                          if timeout_s is None else timeout_s)
+        self._forward = forward_fn or self._default_forward
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self.retry_bucket = TokenBucket(pool.opts.retry_budget,
+                                        pool.opts.retry_refill_per_s)
+
+    @staticmethod
+    def _default_forward(member, method, path, body, timeout):
+        return member.http_raw(method, path, body=body, timeout=timeout)
+
+    def _pick(self, exclude=(), now: Optional[float] = None):
+        """Least-loaded over FRESH queue_depth samples; round-robin over
+        everything routable when no sample is fresh.  A member whose
+        gauge went stale competes round-robin rather than winning on a
+        depth it reported before the world changed."""
+        now = time.monotonic() if now is None else now
+        cands = [m for m in self.pool.routable_members()
+                 if m not in exclude and m.breaker.allow(now)]
+        if not cands:
+            return None
+        ttl = self.pool.opts.stale_after_s
+        fresh = [m for m in cands
+                 if m.depth_t is not None and now - m.depth_t <= ttl]
+        pick_from = cands
+        if fresh:
+            load = min(m.depth + m.inflight for m in fresh)
+            # ties rotate round-robin: an idle fabric must spread load,
+            # not pin every request on the lexicographically-first member
+            pick_from = [m for m in fresh if m.depth + m.inflight == load]
+        with self._rr_lock:
+            m = pick_from[self._rr % len(pick_from)]
+            self._rr += 1
+        return m
+
+    def route_predict(self, body: bytes) -> tuple:
+        """One client request → (status, body_bytes, ctype): least-loaded
+        pick (hedged past ``hedge_after_ms``), then the PR-8 retry-once-
+        on-alternate under the token-bucket budget."""
+        pool = self.pool
+        m = self._pick()
+        if m is None:
+            pool.count("no_ready")
+            return self._shed(f"no routable members "
+                              f"(0/{len(pool.members)} reachable) — "
+                              f"retry with backoff")
+        status, raw, ctype, transport_err, hedge = \
+            self._attempt_hedged(m, body)
+        if transport_err is None and status != 503:
+            return status, raw, ctype
+        if not self.retry_bucket.take():
+            pool.count("retry_budget_exhausted")
+            return self._shed("member failed and the retry budget is "
+                              "exhausted — retry with backoff")
+        pool.count("retry")
+        exclude = (m, hedge) if hedge is not None else (m,)
+        m2 = self._pick(exclude=exclude)
+        if m2 is None:
+            if transport_err is not None:
+                return self._shed(f"member {m.name} failed "
+                                  f"({transport_err}) and no alternate "
+                                  f"is routable — retry with backoff")
+            return status, raw, ctype  # lone member's own 503 stands
+        status2, raw2, ctype2, err2 = self._forward_to(m2, body)
+        if err2 is None:
+            pool.count("retry_ok")
+            return status2, raw2, ctype2
+        return 502, json.dumps(
+            {"error": f"members failed: {transport_err or status}; "
+                      f"then {err2}"}).encode(), "application/json"
+
+    def _attempt_hedged(self, m, body):
+        """First attempt, with the tail hedge: past ``hedge_after_ms``
+        the request is duplicated to a second member and the first 2xx
+        wins.  Returns (status, raw, ctype, transport_err, hedge_member).
+        A hedge is a latency bet against a slow member — counted apart
+        from retries, which answer failures."""
+        hedge_s = self.pool.opts.hedge_after_ms / 1e3
+        if hedge_s <= 0:
+            return self._forward_to(m, body) + (None,)
+        results: "queue.Queue" = queue.Queue()
+
+        def run(member):
+            results.put((member,) + self._forward_to(member, body))
+
+        threading.Thread(target=run, args=(m,), daemon=True,
+                         name="fabric-fwd").start()
+        try:
+            first = results.get(timeout=hedge_s)
+        except queue.Empty:
+            first = None
+        if first is not None:
+            return first[1:] + (None,)
+        m2 = self._pick(exclude=(m,))
+        if m2 is None:  # nobody to hedge to: wait the primary out
+            return results.get(timeout=self.timeout_s + 10.0)[1:] + (None,)
+        self.pool.count("hedge_fired")
+        threading.Thread(target=run, args=(m2,), daemon=True,
+                         name="fabric-hedge").start()
+        def won(r):  # (member, status, raw, ctype, transport_err)
+            return (r[4] is None and r[1] is not None
+                    and 200 <= r[1] < 300)
+
+        winner = results.get(timeout=self.timeout_s + 10.0)
+        if not won(winner):
+            other = results.get(timeout=self.timeout_s + 10.0)
+            if won(other):
+                winner = other
+        if winner[0] is m2:
+            self.pool.count("hedge_won")
+        return winner[1:] + (m2,)
+
+    def _forward_to(self, m, body):
+        """(status, raw, ctype, transport_error) — in-flight counted for
+        reload drains, outcome recorded on the member's breaker."""
+        pool = self.pool
+        m.inflight += 1
+        m.requests += 1
+        pool.counters["requests"] += 1
+        try:
+            status, raw, ctype = self._forward(m, "POST", "/predict",
+                                               body, self.timeout_s)
+        except Exception as e:  # noqa: BLE001 — dead/hung/reset member
+            pool.count("transport_error")
+            pool.note_suspect(m)
+            if m.breaker.record_failure():
+                pool.count("breaker_open")
+                logger.warning("fabric: breaker OPEN for member %s "
+                               "(%d consecutive data-path failures)",
+                               m.name, m.breaker.failures)
+            return None, b"", "", f"{type(e).__name__}: {e}"
+        finally:
+            m.inflight -= 1
+        if status in (500, 502, 504):
+            if m.breaker.record_failure():
+                pool.count("breaker_open")
+                logger.warning("fabric: breaker OPEN for member %s "
+                               "(%d consecutive 5xx)", m.name,
+                               m.breaker.failures)
+        elif status != 503:  # a shed is neither success nor fault
+            m.breaker.record_success()
+        return status, raw, ctype, None
+
+    @staticmethod
+    def _shed(msg: str) -> tuple:
+        return (503, json.dumps({"error": msg}).encode(),
+                "application/json")
+
+    def metrics(self) -> dict:
+        """Pool membership + per-member engine metrics (best-effort live
+        fetch) + fabric aggregates — the operator's single pane."""
+        out = {"fabric": self.pool.metrics()}
+        agg: Dict[str, float] = {}
+        per = {}
+        for m in self.pool.routable_members():
+            try:
+                status, doc = m.http("GET", "/metrics", timeout=5.0)
+            except Exception as e:  # noqa: BLE001 — member mid-death
+                per[m.name] = {"error": f"{type(e).__name__}: {e}"}
+                continue
+            if status == 200 and isinstance(doc, dict):
+                per[m.name] = doc
+                for k, v in (doc.get("counters") or {}).items():
+                    if isinstance(v, (int, float)):
+                        agg[k] = agg.get(k, 0) + v
+        out["engines"] = per
+        out["aggregate_counters"] = agg
+        out["generation"] = self.pool.generation
+        return out
+
+
+def _point_gauge(v) -> dict:
+    return {"count": 1, "mean": v, "min": v, "max": v, "last": v}
+
+
+def fabric_prometheus(router: FabricRouter) -> str:
+    """The fabric router's ``/metrics?format=prom`` body: the same
+    ``fabric/*`` counter names as the JSON view and the telemetry
+    report, through the shared exposition renderer."""
+    pool = router.pool
+    counters = {f"fabric/{k}": v for k, v in pool.counters.items()}
+    gauges = {"fabric/ready_members": _point_gauge(pool.ready_count()),
+              "fabric/members": _point_gauge(len(pool.members)),
+              "fabric/generation": _point_gauge(pool.generation),
+              "fabric/partition_active":
+                  _point_gauge(int(pool.partition))}
+    now = time.monotonic()
+    with pool._lock:
+        for m in pool.members.values():
+            if m.depth is not None:
+                gauges[f"fabric/queue_depth/{m.name}"] = \
+                    _point_gauge(m.depth)
+                gauges[f"fabric/queue_depth_age_s/{m.name}"] = \
+                    _point_gauge(round(now - m.depth_t, 3))
+    rank = telemetry.get().rank
+    return prometheus_text({rank: {"counters": counters,
+                                   "gauges": gauges}})
+
+
+class _FabricHandler(_Handler):
+    """Fabric router HTTP: ``/predict`` forwards bytes to the picked
+    member, ``/admin/register`` admits remote members, ``/admin/reload``
+    rolls a checkpoint across the whole fabric, ``/metrics`` is the
+    folded membership+engine view (JSON or Prometheus)."""
+
+    router: FabricRouter = None
+
+    def do_GET(self):
+        path, _, query = self.path.partition("?")
+        pool = self.router.pool
+        if path == "/healthz":
+            self._reply(200, {"status": "ok", "role": "fabric-router",
+                              "ready_members": pool.ready_count()})
+        elif path == "/readyz":
+            n = pool.ready_count()
+            self._reply(200 if n > 0 else 503,
+                        {"ready": n > 0, "ready_members": n,
+                         "members": len(pool.members),
+                         "partition": pool.partition,
+                         "generation": pool.generation})
+        elif path == "/metrics":
+            accept = self.headers.get("Accept", "")
+            if "format=prom" in query or "text/plain" in accept:
+                self._reply_raw(200,
+                                fabric_prometheus(self.router).encode(),
+                                PROM_CONTENT_TYPE)
+            else:
+                self._reply(200, self.router.metrics())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path == "/predict":
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            status, raw, ctype = self.router.route_predict(body)
+            self._reply_raw(status, raw, ctype or "application/json")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad JSON body: {e}"})
+            return
+        if self.path == "/admin/register":
+            addr = doc.get("address")
+            if not addr:
+                self._reply(400, {"error": "body needs 'address'"})
+                return
+            try:
+                member, created = self.router.pool.register(addr)
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+                return
+            self._reply(200, {"member": member.name, "created": created,
+                              "state": member.state})
+        elif self.path == "/admin/reload":
+            ok = self.router.pool.reload_to(doc)
+            self._reply(200 if ok else 409,
+                        {"ok": ok,
+                         "generation": self.router.pool.generation})
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+
+def make_fabric_server(router: FabricRouter, port: Optional[int] = None,
+                       host: str = "127.0.0.1",
+                       unix_socket: Optional[str] = None):
+    """The fabric's front door — same transports as ``make_server``,
+    driven by a :class:`FabricRouter`."""
+    if (port is None) == (unix_socket is None):
+        raise ValueError("pass exactly one of port / unix_socket")
+
+    class Handler(_FabricHandler):
+        pass
+
+    Handler.router = router
+    if unix_socket is not None:
+        return _UnixHTTPServer(unix_socket, Handler)
+    return _TCPHTTPServer((host, port), Handler)
+
+
+def register_with_router(router_address: str, advertise: str,
+                         stop: Optional[threading.Event] = None,
+                         interval_s: float = 2.0,
+                         timeout_s: float = 5.0) -> threading.Event:
+    """Replica-side ``--join``: a daemon thread POSTs
+    ``/admin/register`` (advertising ``advertise``) until the router
+    acks, then exits — re-admission after an eviction is the ROUTER's
+    re-probe loop, not a re-register.  Returns the stop event."""
+    stop = stop or threading.Event()
+
+    def run():
+        while not stop.is_set():
+            try:
+                status, doc = address_request(
+                    router_address, "POST", "/admin/register",
+                    {"address": advertise}, timeout=timeout_s)
+                if status == 200:
+                    logger.info("joined fabric router %s as member %s",
+                                router_address, doc.get("member"))
+                    return
+                logger.warning("fabric join rejected (%s): %s",
+                               status, doc)
+            except Exception as e:  # noqa: BLE001 — router not up yet
+                logger.debug("fabric join attempt failed: %s", e)
+            stop.wait(interval_s)
+
+    threading.Thread(target=run, daemon=True,
+                     name="fabric-join").start()
+    return stop

@@ -123,6 +123,7 @@ class _Handler(BaseHTTPRequestHandler):
     reloader = None      # optional callback(doc) -> (status, doc)
     request_hook = None  # optional callback(status) after each /predict
     gate = None          # optional callback() before any handling
+    net_faults = None    # optional NetFaults: intercept(path, handler)
 
     # -- plumbing --------------------------------------------------------
 
@@ -150,6 +151,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.gate is not None:
             self.gate()
+        if self.net_faults is not None and \
+                self.net_faults.intercept(self.path, self):
+            return
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             self._reply(200, {"status": "ok",
@@ -173,6 +177,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.gate is not None:
             self.gate()
+        if self.net_faults is not None and \
+                self.net_faults.intercept(self.path, self):
+            return
         if self.path not in ("/predict", "/admin/reload"):
             self._reply(404, {"error": f"no route {self.path}"})
             return
@@ -217,14 +224,18 @@ class _UnixHTTPServer(_TCPHTTPServer):
 def make_server(engine: ServeEngine, port: Optional[int] = None,
                 host: str = "127.0.0.1",
                 unix_socket: Optional[str] = None,
-                reloader=None, request_hook=None, gate=None):
+                reloader=None, request_hook=None, gate=None,
+                net_faults=None):
     """Build (not start) the HTTP server — exactly one of ``port`` /
     ``unix_socket``.  Caller owns ``serve_forever``/``shutdown``.
 
     ``reloader`` enables ``POST /admin/reload`` (the replica hot-swap
     endpoint); ``request_hook(status)`` fires after each ``/predict``
     reply and ``gate()`` before any handling — the chaos harness's
-    kill-after-N / hang injection points."""
+    kill-after-N / hang injection points.  ``net_faults`` (an object
+    with ``intercept(path, handler) -> bool``) sits below both and can
+    blackhole, delay, or reset the connection — the fabric chaos
+    harness's network-layer injection point."""
     if (port is None) == (unix_socket is None):
         raise ValueError("pass exactly one of port / unix_socket")
 
@@ -238,6 +249,7 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
     Handler.request_hook = (staticmethod(request_hook)
                             if request_hook else None)
     Handler.gate = staticmethod(gate) if gate else None
+    Handler.net_faults = net_faults
     if unix_socket is not None:
         return _UnixHTTPServer(unix_socket, Handler)
     return _TCPHTTPServer((host, port), Handler)
@@ -287,6 +299,87 @@ def unix_http_request(sock_path: str, method: str, path: str,
     body = json.dumps(doc).encode() if doc is not None else None
     status, raw, ctype = unix_http_request_raw(
         sock_path, method, path, body=body, timeout=timeout,
+        headers=headers)
+    if "json" in ctype:
+        return status, json.loads(raw)
+    return status, raw.decode()
+
+
+def tcp_http_request_raw(host: str, port: int, method: str, path: str,
+                         body: Optional[bytes] = None,
+                         timeout: float = 60.0,
+                         headers: Optional[dict] = None) -> tuple:
+    """Byte-level HTTP over TCP → (status, body_bytes, ctype): the
+    fabric router's forwarding primitive for remote members — the
+    cross-host twin of :func:`unix_http_request_raw`, with the same
+    pass-through-bytes and raise-on-transport-failure contract."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        hdrs = dict(headers or {})
+        if body:
+            hdrs.setdefault("Content-Type", "application/json")
+        conn.request(method, path, body=body, headers=hdrs)
+        resp = conn.getresponse()
+        return (resp.status, resp.read(),
+                resp.getheader("Content-Type") or "")
+    finally:
+        conn.close()
+
+
+def tcp_http_request(host: str, port: int, method: str, path: str,
+                     doc: Optional[dict] = None, timeout: float = 60.0,
+                     headers: Optional[dict] = None) -> tuple:
+    """JSON-level HTTP over TCP → (status, response_doc) — the client
+    for fabric probes, ``--join`` registration, and the smoke scripts."""
+    body = json.dumps(doc).encode() if doc is not None else None
+    status, raw, ctype = tcp_http_request_raw(
+        host, port, method, path, body=body, timeout=timeout,
+        headers=headers)
+    if "json" in ctype:
+        return status, json.loads(raw)
+    return status, raw.decode()
+
+
+def parse_address(address: str) -> tuple:
+    """``host:port`` → ("tcp", host, port); a filesystem path (optional
+    ``unix:`` prefix) → ("unix", path, None).  The fabric's one address
+    grammar for pool files, ``--join``, and ``/admin/register``."""
+    address = address.strip()
+    if address.startswith("unix:"):
+        return "unix", address[5:], None
+    if "/" in address:
+        return "unix", address, None
+    host, _, port = address.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"address must be HOST:PORT or a unix socket "
+                         f"path, got {address!r}")
+    return "tcp", host, int(port)
+
+
+def address_request_raw(address: str, method: str, path: str,
+                        body: Optional[bytes] = None,
+                        timeout: float = 60.0,
+                        headers: Optional[dict] = None) -> tuple:
+    """Transport-agnostic byte-level request: dispatches on
+    :func:`parse_address` so fabric members are addressed identically
+    whether they live across the network or across a fork."""
+    scheme, host, port = parse_address(address)
+    if scheme == "unix":
+        return unix_http_request_raw(host, method, path, body=body,
+                                     timeout=timeout, headers=headers)
+    return tcp_http_request_raw(host, port, method, path, body=body,
+                                timeout=timeout, headers=headers)
+
+
+def address_request(address: str, method: str, path: str,
+                    doc: Optional[dict] = None, timeout: float = 60.0,
+                    headers: Optional[dict] = None) -> tuple:
+    """JSON twin of :func:`address_request_raw`."""
+    body = json.dumps(doc).encode() if doc is not None else None
+    status, raw, ctype = address_request_raw(
+        address, method, path, body=body, timeout=timeout,
         headers=headers)
     if "json" in ctype:
         return status, json.loads(raw)

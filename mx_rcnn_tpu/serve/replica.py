@@ -43,6 +43,22 @@ Fault-injection env contract (each var is a comma-separated list of
 * ``MXR_FAULT_REPLICA_CORRUPT_CKPT="0"``   — poison every float leaf of
   the next reloaded checkpoint with NaN, forcing the canary probe to
   reject the generation and roll back.
+
+Network fault points (ISSUE 12) — same token grammar, applied at the
+transport layer by :class:`NetFaults` so the fabric's chaos suite can
+stage partitions, connection resets, and tail latency against real
+sockets without touching the code under test:
+
+* ``MXR_FAULT_NET_DROP="1:4"``      — after 4 ``/predict`` requests the
+  member goes dark: EVERY handler (probes included) blackholes.  The
+  router sees pure probe timeouts — a network partition, not a crash.
+* ``MXR_FAULT_NET_RESET="0:3-6"``   — ``/predict`` requests number 3..6
+  (1-based, inclusive; ``"0:3"`` means 3 onward forever) have their
+  connections reset (RST) mid-handshake while probes stay healthy: the
+  data-path-broken/control-path-fine case circuit breakers exist for.
+  A bounded range lets the member RECOVER, closing the breaker.
+* ``MXR_FAULT_NET_DELAY_MS="2:250"`` — every ``/predict`` response is
+  delayed 250 ms: the slow-member tail that request hedging answers.
 """
 
 from __future__ import annotations
@@ -66,6 +82,9 @@ ENV_KILL_AFTER = "MXR_FAULT_REPLICA_KILL_AFTER"
 ENV_HANG_AFTER = "MXR_FAULT_REPLICA_HANG_AFTER"
 ENV_SLOW_START = "MXR_FAULT_REPLICA_SLOW_START_S"
 ENV_CORRUPT_CKPT = "MXR_FAULT_REPLICA_CORRUPT_CKPT"
+ENV_NET_DROP = "MXR_FAULT_NET_DROP"
+ENV_NET_RESET = "MXR_FAULT_NET_RESET"
+ENV_NET_DELAY = "MXR_FAULT_NET_DELAY_MS"
 # set by the supervisor on each child; the injectors match against it
 ENV_REPLICA_INDEX = "MXR_REPLICA_INDEX"
 # optional device pinning: the supervisor splits --replica-devices into
@@ -145,6 +164,83 @@ class ReplicaFaults:
             logger.warning("FAULT replica %d: slow start %.1fs (alive, "
                            "not ready)", self.index, self.slow_start_s)
             time.sleep(self.slow_start_s)
+
+
+class NetFaults:
+    """Parsed ``MXR_FAULT_NET_*`` state for one member index, wired into
+    the frontend as ``net_faults`` (``intercept(path, handler)`` runs
+    before any handling).  With no matching tokens, ``enabled`` is False
+    and the frontend never calls in — zero cost on the clean path."""
+
+    def __init__(self, index: int, env=os.environ):
+        self.index = index
+
+        def _num(name, cast):
+            v = _fault_value(name, index, env)
+            return None if v is None else cast(v) if v != "" else 0
+        self.drop_after = _num(ENV_NET_DROP, int)
+        self.delay_ms = _num(ENV_NET_DELAY, float) or 0.0
+        self.reset_from = None
+        self.reset_to = None
+        reset = _fault_value(ENV_NET_RESET, index, env)
+        if reset:
+            lo, _, hi = reset.partition("-")
+            self.reset_from = int(lo)
+            self.reset_to = int(hi) if hi else None
+        self._predicts = 0
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return (self.drop_after is not None or self.delay_ms > 0
+                or self.reset_from is not None)
+
+    def intercept(self, path: str, handler) -> bool:
+        """True = the request was consumed by a fault (blackholed or
+        reset); False = continue normal handling (possibly delayed)."""
+        p = path.partition("?")[0]
+        with self._lock:
+            if p == "/predict":
+                self._predicts += 1
+            n = self._predicts
+        if self.drop_after is not None and n > self.drop_after:
+            # partition: the member is alive but unreachable — every
+            # path (probes included) blackholes, so the router sees
+            # probe timeouts, not errors
+            logger.warning("FAULT net %d: blackholing %s (partition)",
+                           self.index, p)
+            time.sleep(3600.0)
+            return True
+        if p != "/predict":
+            return False
+        if (self.reset_from is not None and n >= self.reset_from
+                and (self.reset_to is None or n <= self.reset_to)):
+            logger.warning("FAULT net %d: resetting /predict #%d",
+                           self.index, n)
+            self._reset_connection(handler)
+            return True
+        if self.delay_ms > 0:
+            time.sleep(self.delay_ms / 1e3)
+        return False
+
+    @staticmethod
+    def _reset_connection(handler):
+        """Abort the TCP connection with an RST (SO_LINGER 0) so the
+        client sees ConnectionResetError — a broken data path, not a
+        clean HTTP error."""
+        import socket
+        import struct
+        handler.close_connection = True
+        try:
+            handler.connection.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        try:
+            handler.connection.close()
+        except OSError:
+            pass
 
 
 def poison_params(params):
@@ -428,30 +524,49 @@ def make_reloader(engine, predictor, cfg, load_params_fn=None,
 
 # -- the child main loop ---------------------------------------------------
 
-def serve_replica(engine, cfg, sock_path: str, index: int = 0,
-                  predictor=None, load_params_fn=None,
-                  done: Optional[threading.Event] = None) -> None:
+def serve_replica(engine, cfg, sock_path: Optional[str] = None,
+                  index: int = 0, predictor=None, load_params_fn=None,
+                  done: Optional[threading.Event] = None,
+                  port: Optional[int] = None, host: str = "127.0.0.1",
+                  join: Optional[str] = None,
+                  advertise: Optional[str] = None) -> None:
     """Run one replica to completion: HTTP server FIRST (liveness probes
     must answer while warmup compiles), then warmup → ready, then park
     until ``done`` (set by the driver's signal handler) — finally stop
     the server and fail whatever is still queued.  The engine must be
-    ``start()``ed; ``predictor`` defaults to ``engine.predictor``."""
+    ``start()``ed; ``predictor`` defaults to ``engine.predictor``.
+
+    Transport is ``sock_path`` (a fork child behind the PR-8 supervisor)
+    OR ``port``/``host`` (a fabric member on TCP).  ``join`` registers
+    the member with a fabric router at that address once warm,
+    advertising ``advertise`` (default ``host:port``)."""
     predictor = predictor if predictor is not None else engine.predictor
     faults = ReplicaFaults(index)
+    net = NetFaults(index)
     reloader = make_reloader(engine, predictor, cfg,
                              load_params_fn=load_params_fn, faults=faults)
-    server = make_server(engine, unix_socket=sock_path, reloader=reloader,
+    server = make_server(engine, unix_socket=sock_path, port=port,
+                         host=host, reloader=reloader,
                          request_hook=faults.request_hook,
-                         gate=faults.gate)
+                         gate=faults.gate,
+                         net_faults=net if net.enabled else None)
     th = threading.Thread(target=server.serve_forever,
                           name=f"replica-{index}-http", daemon=True)
     th.start()
-    logger.info("replica %d: live on %s (warming)", index, sock_path)
+    where = sock_path if sock_path is not None else f"{host}:{port}"
+    logger.info("replica %d: live on %s (warming)", index, where)
     faults.slow_start()
     warmup(engine)  # sets engine readiness → /readyz flips to 200
     logger.info("replica %d: ready (generation %d)", index,
                 engine.generation)
+    join_stop = None
+    if join:
+        from mx_rcnn_tpu.serve.fabric import register_with_router
+        join_stop = register_with_router(
+            join, advertise or f"{host}:{port}")
     done = done or threading.Event()
     done.wait()
+    if join_stop is not None:
+        join_stop.set()
     server.shutdown()
     engine.stop()

@@ -161,7 +161,12 @@ def build_child_argv(argv: List[str], sock: str, index: int) -> List[str]:
     the replica path before the supervisor branch can recurse)."""
     strip = {"--port": 1, "--host": 1, "--unix-socket": 1,
              "--watch-checkpoints": 1, "--watch-interval-s": 1,
-             "--replica-devices": 1}
+             "--replica-devices": 1,
+             # fabric flags are the ROUTER's business — a fork child is
+             # a plain unix-socket replica even under a fabric parent
+             "--fabric": 0, "--join": 1, "--advertise": 1,
+             "--pool-file": 1, "--hedge-after-ms": 1,
+             "--partition-floor": 1}
     out = [sys.executable, argv[0]]
     i = 1
     while i < len(argv):

@@ -49,6 +49,27 @@ SERVE_COUNTERS = (
     "serve/warmup_programs",
 )
 
+# the cross-host fabric's membership/routing health (serve/fabric.py):
+# rendered as their own section — zeros included — whenever the stream
+# carries any fabric/* event, so "did the pool evict anyone, trip a
+# breaker, hedge, or declare a partition?" is one greppable block
+# (script/fabric_smoke.sh reads it the way replica_smoke reads the
+# supervisor counters)
+FABRIC_COUNTERS = (
+    "fabric/requests",
+    "fabric/member_joined",
+    "fabric/member_evicted",
+    "fabric/member_quarantined",
+    "fabric/breaker_open",
+    "fabric/hedge_fired",
+    "fabric/hedge_won",
+    "fabric/retry",
+    "fabric/retry_ok",
+    "fabric/partition",
+    "fabric/reload",
+    "fabric/reload_rollback",
+)
+
 
 def event_files(paths: Iterable[str]) -> List[str]:
     """Expand run dirs to their per-rank event files; pass files through."""
@@ -189,6 +210,8 @@ def render_table(summary: dict) -> str:
     counters = summary.get("counters", {})
     serving = any(k.startswith("serve/") for k in counters) or any(
         k.startswith("serve/") for k in summary.get("spans", {}))
+    fabric = any(k.startswith("fabric/") for k in counters) or any(
+        k.startswith("fabric/") for k in summary.get("gauges", {}))
     if counters:
         lines.append("")
         lines.append(f"{'counter':<34}{'total':>8}")
@@ -203,6 +226,8 @@ def render_table(summary: dict) -> str:
                 continue  # recovery events get their own section below
             if serving and (name in SERVE_COUNTERS or name in serve_extra):
                 continue  # ditto serve health
+            if fabric and name in FABRIC_COUNTERS:
+                continue  # ditto fabric health
             lines.append(f"{name:<34}{v:>8}")
         lines.append("")
         lines.append(f"{'recovery event':<34}{'total':>8}")
@@ -214,6 +239,11 @@ def render_table(summary: dict) -> str:
             for name in SERVE_COUNTERS:
                 lines.append(f"{name:<34}{counters.get(name, 0):>8}")
             for name in serve_extra:  # per-dtype recompiles + AOT split
+                lines.append(f"{name:<34}{counters.get(name, 0):>8}")
+        if fabric:
+            lines.append("")
+            lines.append(f"{'fabric health':<34}{'total':>8}")
+            for name in FABRIC_COUNTERS:
                 lines.append(f"{name:<34}{counters.get(name, 0):>8}")
     gauges = summary.get("gauges", {})
     if gauges:

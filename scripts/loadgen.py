@@ -59,6 +59,14 @@ queue wait + forward + post-process + transport); ``imgs_per_sec`` is
 code is 1 unless every response was 2xx, and the failure line on stderr
 names each offending status and its count.  Pure stdlib + numpy; no jax
 import, safe on a machine with no accelerator.
+
+Fabric mode (ISSUE 12): with ``--fabric`` the TCP target is a fabric
+router (serve.py --fabric) — the router's ``/metrics`` per-member
+request counters are snapshotted around every scenario and each output
+line/report row gains ``member_share``, the fraction of the scenario's
+routed requests each member served (the routing-balance evidence
+script/fabric_smoke.sh and the FABRIC_r*.json gate read), plus
+``fabric_members``, the live member count at scenario end.
 """
 
 import argparse
@@ -118,6 +126,10 @@ def parse_args(argv=None):
     ap.add_argument("--assert-2xx", action="store_true", dest="assert_2xx",
                     help="exit 1 unless every response was 2xx (stderr "
                          "names the offending statuses)")
+    ap.add_argument("--fabric", action="store_true",
+                    help="target is a fabric router: diff its /metrics "
+                         "per-member request counters around each "
+                         "scenario and report member_share (TCP only)")
     return ap.parse_args(argv)
 
 
@@ -164,6 +176,33 @@ def tcp_request(host, port, doc, timeout):
         return resp.status, json.loads(resp.read())
     finally:
         conn.close()
+
+
+def fabric_member_requests(host, port, timeout=10.0):
+    """``member name → cumulative routed-request count`` from a fabric
+    router's ``/metrics``; ``{}`` when the endpoint is unreachable or not
+    a fabric router (a mid-chaos snapshot must not kill the run)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+    except (OSError, ValueError):
+        return {}
+    finally:
+        conn.close()
+    members = doc.get("fabric", {}).get("members", {})
+    return {name: m.get("requests", 0) for name, m in members.items()
+            if isinstance(m, dict)}
+
+
+def member_share(before: dict, after: dict) -> dict:
+    """Per-member fraction of the requests routed between two snapshots
+    (members that joined mid-window count from zero)."""
+    deltas = {name: after[name] - before.get(name, 0) for name in after}
+    total = sum(d for d in deltas.values() if d > 0)
+    return {name: round(max(d, 0) / max(total, 1), 4)
+            for name, d in sorted(deltas.items())}
 
 
 def run_requests(args, docs, offsets):
@@ -273,6 +312,8 @@ def main(argv=None):
     args = parse_args(argv)
     if bool(args.unix_socket) == bool(args.port):
         raise SystemExit("pass exactly one of --port / --unix-socket")
+    if args.fabric and not args.port:
+        raise SystemExit("--fabric needs a TCP router (--port)")
 
     scenarios = args.scenarios or [None]
     report_rows = []
@@ -282,9 +323,17 @@ def main(argv=None):
                              size_mix=(scenario == "size-mix"))
         offsets = schedule(scenario or "steady", args.n, args.rate,
                            burst=args.burst)
+        before = (fabric_member_requests(args.host, args.port,
+                                         timeout=args.timeout)
+                  if args.fabric else None)
         results, wall = run_requests(args, docs, offsets)
         all_results.extend(results)
         out = summarize(results, wall)
+        if args.fabric:
+            after = fabric_member_requests(args.host, args.port,
+                                           timeout=args.timeout)
+            out["member_share"] = member_share(before, after)
+            out["fabric_members"] = len(after)
         if scenario is not None:
             out = {"scenario": scenario, **out}
         if scenario is not None or args.report:
@@ -292,7 +341,8 @@ def main(argv=None):
                 k: v for k, v in out.items()
                 if k in ("requests", "status", "p50_ms", "p99_ms",
                          "error_rate", "availability", "time_to_recover_s",
-                         "imgs_per_sec", "wall_s")}})
+                         "imgs_per_sec", "wall_s", "member_share",
+                         "fabric_members")}})
         print(json.dumps(out))
 
     if args.report:

@@ -59,6 +59,12 @@ STARTUP_ABS_SLACK_S = 2.0
 # router/supervisor overhead (or accidental serialization) is eating
 # the replication win
 REPLICA_LINEARITY_FLOOR = 0.85
+# cross-host fabric (mxr_fabric_report): same linearity property over N
+# TCP members behind the fabric router, plus the partition floor —
+# while a member is partitioned away the reachable subset must still
+# answer at least this 2xx fraction of non-shed requests
+FABRIC_LINEARITY_FLOOR = 0.85
+FABRIC_PARTITION_AVAILABILITY_FLOOR = 0.90
 # overlapped-eval floor: the pipelined pred_eval must at least match the
 # serial loop on the same box (speedup ratio >= 1.0) — a pipeline that
 # loses to serial means the overlap machinery is pure overhead
@@ -123,6 +129,41 @@ def replica_report_rows(doc: dict) -> list:
     return rows
 
 
+def fabric_report_rows(doc: dict) -> list:
+    """Expand an ``mxr_fabric_report`` (script/fabric_smoke.sh) into
+    FLOOR rows, the replica-report dialect generalized to remote TCP
+    members: linearity of aggregate throughput across N members, chaos
+    availability, and — the fabric-specific property — availability
+    while a member is partitioned away."""
+    rows = []
+    n = doc.get("members")
+    agg = doc.get("aggregate_imgs_per_sec")
+    per = doc.get("per_member_imgs_per_sec")
+    if (isinstance(n, int) and n > 0
+            and isinstance(agg, (int, float))
+            and isinstance(per, (int, float)) and per > 0):
+        rows.append({"metric": "fabric_linearity",
+                     "value": round(agg / (per * n), 4),
+                     "unit": "fraction",
+                     "floor": doc.get("linearity_floor",
+                                      FABRIC_LINEARITY_FLOOR)})
+    avail = doc.get("availability")
+    if isinstance(avail, (int, float)):
+        row = {"metric": "fabric_availability", "value": avail,
+               "unit": "fraction"}
+        floor = doc.get("availability_floor")
+        if isinstance(floor, (int, float)):
+            row["floor"] = floor
+        rows.append(row)
+    part = doc.get("availability_under_partition")
+    if isinstance(part, (int, float)):
+        rows.append({"metric": "fabric_partition_availability",
+                     "value": part, "unit": "fraction",
+                     "floor": doc.get("partition_availability_floor",
+                                      FABRIC_PARTITION_AVAILABILITY_FLOOR)})
+    return rows
+
+
 def load_rows(path: str) -> list:
     """Extract metric rows from one trajectory artifact.  Shapes seen in
     the wild: the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
@@ -136,6 +177,8 @@ def load_rows(path: str) -> list:
         return slo_report_rows(doc)
     if isinstance(doc, dict) and doc.get("schema") == "mxr_replica_report":
         return replica_report_rows(doc)
+    if isinstance(doc, dict) and doc.get("schema") == "mxr_fabric_report":
+        return fabric_report_rows(doc)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return startup_rows([doc["parsed"]])
     if isinstance(doc, dict) and "metric" in doc:
@@ -311,10 +354,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("paths", nargs="*",
                     help="trajectory files (default: --dir/BENCH_r*.json "
-                         "+ --dir/SLO_r*.json + --dir/REPLICA_r*.json)")
+                         "+ --dir/SLO_r*.json + --dir/REPLICA_r*.json + "
+                         "--dir/FABRIC_r*.json)")
     ap.add_argument("--dir", default=".",
                     help="where to glob BENCH_r*.json / SLO_r*.json / "
-                         "REPLICA_r*.json when no paths given")
+                         "REPLICA_r*.json / FABRIC_r*.json when no paths "
+                         "given")
     ap.add_argument("--threshold", type=float, default=GATE_THRESHOLD,
                     help="allowed fractional drop vs the best prior run "
                          "(default 0.10)")
@@ -327,7 +372,8 @@ def main(argv=None) -> int:
     paths = args.paths or (
         sorted(glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "SLO_r*.json")))
-        + sorted(glob.glob(os.path.join(args.dir, "REPLICA_r*.json"))))
+        + sorted(glob.glob(os.path.join(args.dir, "REPLICA_r*.json")))
+        + sorted(glob.glob(os.path.join(args.dir, "FABRIC_r*.json"))))
     if not paths:
         print("perf_gate: no BENCH_*.json / SLO_*.json files found",
               file=sys.stderr)

@@ -21,6 +21,11 @@ asserts on it.  Streams from a serving run (serve.py / bench.py --mode
 serve) additionally get a "serve health" section — requests/batches plus
 the rejection, deadline-exceeded, and post-warmup recompile counters,
 zeros included — which script/serve_smoke.sh asserts on the same way.
+Streams from a fabric router (serve.py --fabric) get a "fabric health"
+section on top: membership churn (member_joined / member_evicted /
+member_quarantined), circuit-breaker opens, hedges fired/won, retries,
+partitions, and rolling reloads, zeros included;
+script/fabric_smoke.sh asserts on it.
 
 Streams carrying ``pipeline_cell`` meta rows — a live run of ``bench.py
 --mode pipeline``, or its ``--sweep-out`` JSONL passed directly as a

@@ -19,6 +19,11 @@ serve ``/predict`` with bucket-aware dynamic batching until SIGTERM/SIGINT.
     python serve.py --network resnet101 --prefix model/e2e --epoch 10 \
         --port 8321 --replicas 2 --watch-checkpoints model/e2e
 
+    # cross-host fabric (ISSUE 12): a router that members join over TCP
+    python serve.py --fabric --port 8320                  # the router
+    python serve.py --network resnet50 --synthetic --port 8321 \
+        --join 127.0.0.1:8320                             # a member
+
 Scale-out contract (``--replicas N``): the parent builds NO model — it
 runs the ReplicaSupervisor + ReplicaRouter (serve/supervisor.py) over N
 child processes of this same script (``--replica-index I``, internal),
@@ -123,6 +128,40 @@ def parse_args():
     parser.add_argument("--watch-interval-s", type=float, default=5.0,
                         dest="watch_interval_s",
                         help="checkpoint watcher poll period")
+    # -- cross-host fabric (ISSUE 12) — all opt-in; the fork-based
+    # --replicas path is untouched when none of these are passed
+    parser.add_argument("--fabric", action="store_true",
+                        help="run the cross-host fabric router: remote "
+                             "members join via --join/--pool-file//admin/"
+                             "register; with --replicas N local fork "
+                             "children serve alongside them")
+    parser.add_argument("--pool-file", default="", dest="pool_file",
+                        help="seed fabric membership from this file (one "
+                             "HOST:PORT or unix socket path per line; "
+                             "implies --fabric)")
+    parser.add_argument("--join", default="",
+                        help="run as a fabric MEMBER: serve on --port and "
+                             "register with the fabric router at this "
+                             "HOST:PORT once warm")
+    parser.add_argument("--advertise", default="",
+                        help="address to advertise to the router on "
+                             "--join (default: --host:--port — set this "
+                             "when members sit behind NAT/containers)")
+    parser.add_argument("--hedge-after-ms", type=float, default=0.0,
+                        dest="hedge_after_ms",
+                        help="router tail hedging: duplicate a request "
+                             "still unanswered after this long to a "
+                             "second member and take the first 2xx "
+                             "(0 = off)")
+    parser.add_argument("--partition-floor", type=float, default=0.5,
+                        dest="partition_floor",
+                        help="ready-member fraction below which the "
+                             "router flight-dumps fabric_partition (it "
+                             "keeps serving the reachable subset "
+                             "regardless)")
+    parser.add_argument("--probe-interval-s", type=float, default=1.0,
+                        dest="probe_interval_s",
+                        help="fabric membership probe period")
     return parser.parse_args()
 
 
@@ -327,14 +366,128 @@ def main_plane(args):
                          "the respawn limit (see flight dumps)")
 
 
-def main(args):
-    if args.replica_index >= 0:
-        # the child check comes FIRST: children keep --replicas for the
-        # obs world size, and must never recurse into main_plane
-        return main_replica(args)
+def main_member(args):
+    """A standalone fabric member (--join): the full engine stack over
+    TCP, self-registering with the fabric router once warm.  Reloads
+    arrive from the ROUTER's rolling ``/admin/reload`` — a member never
+    watches checkpoints itself, or a roll would double-swap it."""
+    import sys  # noqa: F401 — parallel to the other mains
+
+    from mx_rcnn_tpu.serve import serve_replica
+
+    if not args.unix_socket and not args.port:
+        raise SystemExit("pass --port (or --unix-socket) for a fabric "
+                         "member")
+    cfg = config_from_args(args, train=False)
+    index = int(os.environ.get("MXR_REPLICA_INDEX", "0"))
+    obs = start_observability(args, "serve",
+                              run_meta={"network": args.network,
+                                        "join": args.join,
+                                        "member_index": index},
+                              configure_telemetry=True)
+    predictor, engine = _build_engine(args, cfg)
+    done = threading.Event()
+    _install_signals(done)
+    try:
+        serve_replica(engine, cfg,
+                      sock_path=args.unix_socket or None,
+                      port=args.port or None, host=args.host,
+                      index=index, predictor=predictor, done=done,
+                      join=args.join, advertise=args.advertise or None)
+    finally:
+        obs.close(extra={"serve": engine.metrics()})
+
+
+def main_fabric(args):
+    """The fabric router (--fabric / --pool-file): probe-driven
+    membership over remote TCP members (plus local fork children when
+    --replicas N > 1), least-loaded routing, breakers, hedging, and
+    rolling cross-member hot reload."""
+    import sys
+
+    from mx_rcnn_tpu.serve import (CheckpointWatcher, FabricOptions,
+                                   FabricRouter, ReplicaPool,
+                                   ReplicaSupervisor, make_fabric_server,
+                                   replica_specs)
+
+    if not args.unix_socket and not args.port:
+        raise SystemExit("pass --port or --unix-socket")
+    obs = start_observability(args, "serve",
+                              run_meta={"network": args.network,
+                                        "fabric": True,
+                                        "replicas": args.replicas},
+                              configure_telemetry=True)
+    pool = ReplicaPool(FabricOptions(
+        probe_interval_s=args.probe_interval_s,
+        hedge_after_ms=args.hedge_after_ms,
+        partition_floor=args.partition_floor))
+    done = threading.Event()
+    sup = None
     if args.replicas > 1:
-        return main_plane(args)
-    return main_single(args)
+        sock_dir = tempfile.mkdtemp(prefix="mxr_replicas_")
+        specs = replica_specs(sys.argv, args.replicas, sock_dir,
+                              devices=args.replica_devices)
+        sup = ReplicaSupervisor(specs)
+        atexit.register(sup.sweep)
+        _install_signals(done, hard_cleanup=lambda: sup.sweep(0.0))
+        sup.start()
+        pool.adopt_supervisor(sup)
+    else:
+        _install_signals(done)
+    if args.pool_file:
+        n = pool.load_pool_file(args.pool_file)
+        logger.info("fabric: seeded %d member address(es) from %s",
+                    n, args.pool_file)
+    pool.start()
+    router = FabricRouter(pool)
+    server = make_fabric_server(router, port=args.port or None,
+                                host=args.host,
+                                unix_socket=args.unix_socket or None)
+    watcher = None
+    if args.watch_checkpoints:
+        watcher = CheckpointWatcher(args.watch_checkpoints,
+                                    pool.reload_to,
+                                    interval_s=args.watch_interval_s)
+        watcher.start()
+    t = threading.Thread(target=server.serve_forever, name="fabric-http",
+                         daemon=True)
+    t.start()
+    where = args.unix_socket or f"http://{args.host}:{args.port}"
+    logger.info("fabric router on %s (%d seeded member(s), %d local "
+                "replica(s))", where, len(pool.members),
+                args.replicas if sup is not None else 0)
+    done.wait()
+    logger.info("fabric shutting down: %s", pool.counters)
+    server.shutdown()
+    if watcher is not None:
+        watcher.stop()
+    pool.stop()
+    if sup is not None:
+        sup.stop()
+    obs.close(extra={"fabric": pool.metrics()})
+
+
+def choose_mode(args) -> str:
+    """argv → serving mode.  Order is a contract: child replicas first
+    (never recurse into a plane), then the opt-in fabric paths, then the
+    PR-8 fork plane, else the classic single server.  With none of the
+    fabric flags set, dispatch is EXACTLY the pre-fabric decision tree —
+    the fork path cannot be perturbed by dormant fabric code."""
+    if args.replica_index >= 0:
+        return "replica"
+    if getattr(args, "fabric", False) or getattr(args, "pool_file", ""):
+        return "fabric"
+    if getattr(args, "join", ""):
+        return "member"
+    if args.replicas > 1:
+        return "plane"
+    return "single"
+
+
+def main(args):
+    return {"replica": main_replica, "fabric": main_fabric,
+            "member": main_member, "plane": main_plane,
+            "single": main_single}[choose_mode(args)](args)
 
 
 if __name__ == "__main__":

@@ -24,7 +24,13 @@ Serve-side chaos (ISSUE 8): the injectors themselves live in
 ``MXR_FAULT_*`` train injectors above); this module only provides
 :func:`replica_fault_env`, the composer tests and
 ``script/replica_smoke.sh`` use to build the env dict for a chosen
-replica index, so the var names have exactly one spelling."""
+replica index, so the var names have exactly one spelling.
+
+Fabric-side network chaos (ISSUE 12) follows the same split:
+``MXR_FAULT_NET_{DROP,DELAY_MS,RESET}`` are parsed by ``NetFaults`` in
+``mx_rcnn_tpu/serve/replica.py`` and injected member-side at the HTTP
+frontend; :func:`net_fault_env` is the composer for
+tests/test_fabric.py and script/fabric_smoke.sh."""
 
 from __future__ import annotations
 
@@ -135,6 +141,38 @@ def replica_fault_env(index: int, kill_after=None, hang_after=None,
         env[ENV_SLOW_START] = f"{index}:{float(slow_start_s)}"
     if corrupt_ckpt:
         env[ENV_CORRUPT_CKPT] = str(index)
+    return env
+
+
+def net_fault_env(index: int, drop_after=None, delay_ms=None,
+                  reset_from=None, reset_to=None) -> dict:
+    """Compose the ``MXR_FAULT_NET_*`` env dict injecting network faults
+    into fabric member ``index`` (index-matched tokens, like
+    :func:`replica_fault_env`):
+
+    * ``drop_after=N`` — after serving N ``/predict`` requests the member
+      blackholes EVERY path including probes (accepted connections hang):
+      the network-partition shape, seen by the router as probe timeouts.
+    * ``delay_ms=D`` — every ``/predict`` response is delayed by D ms
+      (probes unaffected): the tail-latency shape request hedging exists
+      for.
+    * ``reset_from=N`` (optionally with ``reset_to=M``) — ``/predict``
+      requests N..M (1-based, inclusive; open-ended without ``reset_to``)
+      are answered with a hard TCP RST while probes stay healthy: the
+      flaky-member shape that must trip the per-member circuit breaker
+      (and, when bounded, let it close again after recovery)."""
+    from mx_rcnn_tpu.serve.replica import (ENV_NET_DELAY, ENV_NET_DROP,
+                                           ENV_NET_RESET)
+
+    env = {}
+    if drop_after is not None:
+        env[ENV_NET_DROP] = f"{index}:{int(drop_after)}"
+    if delay_ms is not None:
+        env[ENV_NET_DELAY] = f"{index}:{float(delay_ms)}"
+    if reset_from is not None:
+        spec = (f"{int(reset_from)}" if reset_to is None
+                else f"{int(reset_from)}-{int(reset_to)}")
+        env[ENV_NET_RESET] = f"{index}:{spec}"
     return env
 
 
