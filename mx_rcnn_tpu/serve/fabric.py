@@ -140,6 +140,20 @@ class CircuitBreaker:
             # HALF_OPEN with the trial already in flight: hold the line
             return False
 
+    def can_attempt(self, now: Optional[float] = None) -> bool:
+        """Side-effect-free view of :meth:`allow`: True when a request
+        COULD go through right now.  Candidate filters must use this —
+        calling allow() on a member that is never actually picked burns
+        the single half-open trial with no request behind it, and the
+        breaker then stays open forever."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                return now >= self.open_until
+            return False  # HALF_OPEN: the one trial is already in flight
+
     def record_success(self):
         with self._lock:
             self.state = self.CLOSED
@@ -189,6 +203,7 @@ class RemoteMember:
         self.ready_t = 0.0
         self.next_probe_t = 0.0   # eviction backoff schedule
         self.last_reload = None   # last /admin/reload response doc
+        self.inflight_lock = threading.Lock()  # hedge + handler threads
         self.breaker = CircuitBreaker(opts.breaker_failures,
                                       opts.breaker_cooldown_s)
 
@@ -227,6 +242,7 @@ class LocalMember:
         self.depth = None
         self.depth_t = None
         self.last_reload = None
+        self.inflight_lock = threading.Lock()  # hedge + handler threads
         self.breaker = CircuitBreaker(opts.breaker_failures,
                                       opts.breaker_cooldown_s)
 
@@ -696,7 +712,12 @@ class ReplicaPool:
                 gen = self.generation + 1
             target = dict(target, generation=gen)
             swapped: List[object] = []
-            victims = [m for m in self.members.values() if m.is_ready()]
+            # snapshot under the lock: a concurrent /admin/register
+            # mutates the dict mid-roll otherwise, and _reload_one
+            # blocks far too long to hold a live dict iterator across
+            with self._lock:
+                victims = [m for m in self.members.values()
+                           if m.is_ready()]
             if not victims:
                 logger.warning("fabric reload_to: no ready members")
                 return False
@@ -725,7 +746,9 @@ class ReplicaPool:
                 self.generation = max(self.generation, gen)
             self._prev_target, self._target = self._target, target
             # anyone who joined or re-admitted mid-roll missed the list
-            for m in self.members.values():
+            with self._lock:
+                stragglers = list(self.members.values())
+            for m in stragglers:
                 if m.is_ready() and m.generation < gen:
                     self._reload_one(m, target)
             telemetry.get().gauge("fabric/generation", self.generation)
@@ -793,7 +816,7 @@ class FabricRouter:
         depth it reported before the world changed."""
         now = time.monotonic() if now is None else now
         cands = [m for m in self.pool.routable_members()
-                 if m not in exclude and m.breaker.allow(now)]
+                 if m not in exclude and m.breaker.can_attempt(now)]
         if not cands:
             return None
         ttl = self.pool.opts.stale_after_s
@@ -805,10 +828,21 @@ class FabricRouter:
             # ties rotate round-robin: an idle fabric must spread load,
             # not pin every request on the lexicographically-first member
             pick_from = [m for m in fresh if m.depth + m.inflight == load]
-        with self._rr_lock:
-            m = pick_from[self._rr % len(pick_from)]
-            self._rr += 1
-        return m
+        # only the member actually picked consumes allow(): the filter
+        # above is side-effect-free, so an unpicked half-open member
+        # keeps its trial for the pick that will really send a request.
+        # A breaker that raced OPEN between filter and pick costs one
+        # candidate, not the whole request.
+        rest = [m for m in cands if m not in pick_from]
+        for group in (list(pick_from), rest):
+            while group:
+                with self._rr_lock:
+                    m = group[self._rr % len(group)]
+                    self._rr += 1
+                if m.breaker.allow(now):
+                    return m
+                group.remove(m)
+        return None
 
     def route_predict(self, body: bytes) -> tuple:
         """One client request → (status, body_bytes, ctype): least-loaded
@@ -891,8 +925,12 @@ class FabricRouter:
         """(status, raw, ctype, transport_error) — in-flight counted for
         reload drains, outcome recorded on the member's breaker."""
         pool = self.pool
-        m.inflight += 1
-        m.requests += 1
+        # hedge threads and handler threads race on the same member; a
+        # lost += / -= leaves inflight pinned nonzero and every later
+        # reload of this member eats the full drain timeout
+        with m.inflight_lock:
+            m.inflight += 1
+            m.requests += 1
         pool.counters["requests"] += 1
         try:
             status, raw, ctype = self._forward(m, "POST", "/predict",
@@ -907,7 +945,8 @@ class FabricRouter:
                                m.name, m.breaker.failures)
             return None, b"", "", f"{type(e).__name__}: {e}"
         finally:
-            m.inflight -= 1
+            with m.inflight_lock:
+                m.inflight -= 1
         if status in (500, 502, 504):
             if m.breaker.record_failure():
                 pool.count("breaker_open")
@@ -964,7 +1003,10 @@ def fabric_prometheus(router: FabricRouter) -> str:
     now = time.monotonic()
     with pool._lock:
         for m in pool.members.values():
-            if m.depth is not None:
+            # gate on depth_t, not depth: _evict clears only depth_t
+            # (the stale-gauge contract), so an evicted member keeps a
+            # depth value with no receipt timestamp to age against
+            if m.depth is not None and m.depth_t is not None:
                 gauges[f"fabric/queue_depth/{m.name}"] = \
                     _point_gauge(m.depth)
                 gauges[f"fabric/queue_depth_age_s/{m.name}"] = \

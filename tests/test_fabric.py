@@ -449,6 +449,38 @@ def test_readmitted_member_catches_up_to_pool_generation():
     assert hz.pool.members[B].generation == 1
 
 
+def test_reload_roll_survives_concurrent_register():
+    """A /admin/register landing mid-roll (handler threads mutate the
+    member dict while reload_to blocks inside _reload_one) must not
+    abort the roll: the victim list and the post-roll catch-up loop
+    both iterate a locked snapshot, never the live dict."""
+    hz = PoolHarness()
+    for name in (A, B):
+        hz.pool.register(name, now=0.0)
+        hz.up(name)
+    hz.pool.poll(now=1.0)
+    late_ready = []
+
+    def reload_status(member, target):
+        # every swap, a new member registers — the handler-thread race
+        # run inline, so the dict mutates at the worst possible moment
+        hz.pool.register(f"10.0.9.{len(hz.pool.members)}:8000", now=2.0)
+        if member.name == A and not late_ready:
+            # ... and one arrives READY at a stale generation, forcing
+            # the catch-up loop itself to reload (and thus re-register)
+            # mid-pass
+            late, _ = hz.pool.register(C, now=2.0)
+            late.state = fb.MEMBER_READY
+            late.routable = True
+            late_ready.append(late)
+        return 200
+
+    hz.reload_status = reload_status
+    assert hz.pool.reload_to({"prefix": "/g1", "kind": "file"})
+    assert hz.pool.generation == 1
+    assert hz.pool.members[C].generation == 1  # straggler caught up
+
+
 # -- router: least-loaded, the stale-gauge pin, retries, hedging ------------
 
 
@@ -508,6 +540,31 @@ def test_open_breaker_excludes_member_from_picks():
     hz.pool.members[A].breaker.open_until = 1e18
     router = fb.FabricRouter(hz.pool)
     assert router._pick(now=100.1).name == B
+
+
+def test_unpicked_candidate_keeps_half_open_trial():
+    """THE breaker-consumption pin: a cooled-down OPEN member that is a
+    candidate but loses the least-loaded pick must KEEP its half-open
+    trial — candidate filtering is can_attempt() (side-effect-free),
+    and only the member actually picked pays allow().  Filtering with
+    allow() burned the trial with no request behind it, leaving the
+    member permanently unroutable after any transient failure burst."""
+    hz = _ready_pool({A: 9, B: 0}, now=100.0)
+    m_a = hz.pool.members[A]
+    m_a.breaker.state = fb.CircuitBreaker.OPEN
+    m_a.breaker.open_until = 99.0              # cooldown elapsed
+    router = fb.FabricRouter(hz.pool)
+    for _ in range(4):                         # B always wins on depth
+        assert router._pick(now=100.1).name == B
+    # A was a losing candidate 4 times over — its trial must survive
+    assert m_a.breaker.state == fb.CircuitBreaker.OPEN
+    assert m_a.breaker.can_attempt(100.2)
+    # ... and the pick that finally lands on A consumes it for real
+    hz.pool.members[B].routable = False
+    assert router._pick(now=100.3).name == A
+    assert m_a.breaker.state == fb.CircuitBreaker.HALF_OPEN
+    m_a.breaker.record_success()
+    assert m_a.breaker.state == fb.CircuitBreaker.CLOSED
 
 
 def test_route_predict_retries_once_on_alternate():
@@ -657,6 +714,19 @@ def test_fabric_prometheus_exposition():
     assert "fabric_ready_members" in text
     assert "fabric_partition_active" in text
     assert "fabric_queue_depth" in text
+
+
+def test_fabric_prometheus_survives_evicted_member():
+    """_evict clears depth_t but keeps depth; the Prometheus view must
+    gate the age gauge on depth_t or /metrics?format=prom 500s whenever
+    any member sits evicted awaiting re-probe."""
+    hz = _ready_pool({A: 2, B: 1}, now=time.monotonic())
+    hz.pool._evict(hz.pool.members[A], now=time.monotonic(),
+                   reason="injected")
+    text = fb.fabric_prometheus(fb.FabricRouter(hz.pool))  # must not raise
+    # the evicted member's gauges drop; the survivor's still render
+    assert "queue_depth_age_s_10_0_0_1:8000" not in text
+    assert "queue_depth_age_s_10_0_0_2:8000" in text
 
 
 # -- satellite gates: loadgen member share + perf_gate fabric rows ----------
