@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Data-flywheel driver: mine captured serving traffic, run replay rounds.
+
+Subcommands:
+
+  mine   scan a ``--capture-dir`` for spilled shards, rank by hardness and
+         write a ``mined-<digest>.json`` manifest (atomic tmp+rename).
+  loop   run N capture->mine->train rounds; the train command (everything
+         after ``--``) gets ``--replay-manifest <path>`` appended each
+         round.  Serving replicas pick up the resulting checkpoints via
+         ``--watch-checkpoints`` on their own.
+
+Each invocation prints one JSON line so smoke scripts can consume it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.flywheel import FlywheelLoop
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="Data flywheel driver")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for name in ("mine", "loop"):
+        p = sub.add_parser(name)
+        p.add_argument("--capture-dir", required=True,
+                       help="dir the serve engine spills shards into")
+        p.add_argument("--top-k", type=int, default=64,
+                       help="hardest records kept per manifest")
+        p.add_argument("--min-label-score", type=float, default=0.3,
+                       help="records need one detection at or above this "
+                            "to carry a usable pseudo-label")
+        p.add_argument("--out-dir", default=None,
+                       help="manifest output dir (default: capture dir)")
+        p.add_argument("--telemetry-dir", default=None)
+        if name == "loop":
+            p.add_argument("--rounds", type=int, default=1)
+            p.add_argument("train_cmd", nargs=argparse.REMAINDER,
+                           help="train command after --; gets "
+                                "--replay-manifest appended per round")
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.telemetry_dir:
+        telemetry.configure(args.telemetry_dir, rank=0, world=1)
+    train_cmd = None
+    if args.cmd == "loop":
+        train_cmd = [t for t in args.train_cmd if t != "--"] or None
+    loop = FlywheelLoop(args.capture_dir, top_k=args.top_k,
+                        min_label_score=args.min_label_score,
+                        out_dir=args.out_dir, train_cmd=train_cmd)
+    if args.cmd == "mine":
+        results = [loop.run_round(0)]
+    else:
+        results = loop.run(args.rounds)
+    if args.telemetry_dir:
+        telemetry.shutdown()
+    last = results[-1]
+    print(json.dumps({"cmd": args.cmd, "rounds": len(results),
+                      "mined": last["mined"], "scanned": last["scanned"],
+                      "manifest": last["manifest"],
+                      "train_rc": last["train_rc"]}))
+    if any(r["train_rc"] not in (None, 0) for r in results):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

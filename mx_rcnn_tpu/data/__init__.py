@@ -14,4 +14,5 @@ from mx_rcnn_tpu.data.image import get_image, transform_image, resize_to_bucket
 from mx_rcnn_tpu.data.imdb import IMDB
 from mx_rcnn_tpu.data.loader import (AnchorLoader, TestLoader, ROIIter,
                                      prepare_image)
+from mx_rcnn_tpu.data.replay import ReplayDataset, load_replay_pixels
 from mx_rcnn_tpu.data.synthetic import SyntheticDataset

@@ -63,7 +63,15 @@ def _load_record(rec: dict, cfg: Config, scale: Tuple[int, int],
     eval and proposal loaders never consume them)."""
     device_prep = getattr(cfg.tpu, "DEVICE_PREP", False)
     flipped = bool(rec.get("flipped", False))
-    if "image_array" in rec:  # synthetic dataset ships pixels inline
+    if "replay_npz" in rec:  # flywheel replay shard (data/replay.py)
+        from mx_rcnn_tpu.data.replay import load_replay_pixels
+
+        # raises on a corrupt/truncated shard — train loaders land in the
+        # bad-record substitution path below, eval loaders stay strict
+        im = load_replay_pixels(rec)
+        if flipped and not device_prep:
+            im = im[:, ::-1, :]
+    elif "image_array" in rec:  # synthetic dataset ships pixels inline
         im = rec["image_array"]
         if flipped and not device_prep:  # device prep mirrors on device
             im = im[:, ::-1, :]
@@ -359,7 +367,9 @@ class AnchorLoader:
 
     def __init__(self, roidb: list, cfg: Config, batch_size: int,
                  shuffle: bool = True, seed: int = 0,
-                 num_parts: int = 1, part_index: int = 0):
+                 num_parts: int = 1, part_index: int = 0,
+                 replay_roidb: Optional[list] = None,
+                 replay_ratio: float = 0.0):
         if not roidb:
             raise ValueError("empty roidb")
         if not (0 <= part_index < num_parts):
@@ -367,7 +377,26 @@ class AnchorLoader:
         if batch_size % num_parts:
             raise ValueError(f"batch_size {batch_size} does not divide over "
                              f"{num_parts} parts")
-        self.roidb = roidb
+        if not (0.0 <= replay_ratio < 1.0):
+            raise ValueError(f"replay_ratio must be in [0, 1), "
+                             f"got {replay_ratio}")
+        # flywheel replay mixing (data/replay.py): mined records append
+        # AFTER the base roidb; the epoch schedule (groups, steps, wrap)
+        # is computed from the base alone, and each assembled batch then
+        # substitutes ~replay_ratio of its slots with same-orientation
+        # replay records.  All draws come from self._rng at plan time, so
+        # the mix is bit-reproducible under advance_epochs/skip_next.
+        replay_roidb = list(replay_roidb) if replay_roidb else []
+        base_n = len(roidb)
+        self.roidb = list(roidb) + replay_roidb
+        self.replay_ratio = replay_ratio if replay_roidb else 0.0
+        self._replay_groups = [
+            [base_n + i for i, r in enumerate(replay_roidb)
+             if r["width"] >= r["height"]],
+            [base_n + i for i, r in enumerate(replay_roidb)
+             if r["width"] < r["height"]],
+        ]
+        self.replay_substituted = 0  # cumulative slots replaced
         self.cfg = cfg
         self.batch_size = batch_size
         self.num_parts = num_parts
@@ -406,17 +435,30 @@ class AnchorLoader:
 
     def _epoch_indices(self) -> List[np.ndarray]:
         batches = []
-        for g in self._groups:
+        for gi, g in enumerate(self._groups):
             if not g:
                 continue
             idx = np.asarray(g)
             if self.shuffle:
                 self._rng.shuffle(idx)
+            pool = (self._replay_groups[gi]
+                    if self.replay_ratio > 0 else [])
             for i in range(0, len(idx), self.batch_size):
                 chunk = idx[i:i + self.batch_size]
                 if len(chunk) < self.batch_size:  # wrap like the reference
                     extra = self._rng.choice(idx, self.batch_size - len(chunk))
                     chunk = np.concatenate([chunk, extra])
+                if pool:
+                    # replay substitution, drawn from the SAME RandomState
+                    # as the rest of the plan (never wall clock) — the mix
+                    # replays bit-identically on resume
+                    mask = self._rng.rand(len(chunk)) < self.replay_ratio
+                    k = int(mask.sum())
+                    if k:
+                        chunk = chunk.copy()
+                        chunk[mask] = self._rng.choice(pool, size=k)
+                        self.replay_substituted += k
+                        telemetry.get().counter("flywheel/replayed", k)
                 batches.append(chunk)
         if self.shuffle:
             order = self._rng.permutation(len(batches))

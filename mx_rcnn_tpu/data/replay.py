@@ -1,0 +1,126 @@
+"""ReplayDataset: an imdb-compatible view over mined capture shards.
+
+Turns a ``mined-<digest>.json`` manifest (:mod:`mx_rcnn_tpu.flywheel.miner`)
+into a roidb the loader can mix into the epoch plan.  Pseudo-labels come
+from the serving detections: boxes at or above ``min_score`` become gt
+boxes with the served class.
+
+Coordinate contract: the served detections are in ORIGINAL image
+coordinates, while the captured pixels are the staged buffer whose valid
+extent is ``raw_hw`` (oversized raws were pre-shrunk host-side before
+staging, see ``stage_raw_to_bucket``).  Record boxes are therefore scaled
+by ``raw_hw / orig_hw`` per axis and clipped into the raw extent, so they
+line up with the pixels :func:`load_replay_pixels` returns.
+
+Pixels are loaded lazily per record from the shard npz — no handle
+caching, so fork-based loader workers (PR-4) stay safe — and a corrupt or
+truncated shard raises from ``np.load``, which lands in the loader's
+deterministic bad-record substitution path (PR-2).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.data.imdb import IMDB
+
+
+class ReplayDataset(IMDB):
+    """Dataset over one mined manifest.
+
+    ``num_classes`` must match the training config's class count; served
+    class ids are already in that space (the model produced them).
+    Entries whose pseudo-labels all fall below ``min_score`` are dropped.
+    """
+
+    def __init__(self, manifest_path: str, num_classes: int,
+                 min_score: float = 0.5):
+        from mx_rcnn_tpu.flywheel.miner import load_manifest
+
+        doc = load_manifest(manifest_path)
+        digest = os.path.basename(manifest_path)
+        super().__init__("replay", os.path.splitext(digest)[0],
+                         "data", "data")
+        self.classes = ["__background__"] + [
+            f"class{i}" for i in range(1, num_classes)]
+        self.manifest_path = manifest_path
+        self.capture_dir = doc["capture_dir"]
+        self.min_score = float(min_score)
+        self._entries = doc["entries"]
+        self._roidb: Optional[list] = None
+        self.num_images = 0
+
+    def gt_roidb(self) -> list:
+        if self._roidb is not None:
+            return self._roidb
+        roidb = []
+        for e in self._entries:
+            rec = self._entry_record(e)
+            if rec is not None:
+                roidb.append(rec)
+        self.num_images = len(roidb)
+        self._roidb = roidb
+        return roidb
+
+    def _entry_record(self, e):
+        rh, rw = int(e["raw_hw"][0]), int(e["raw_hw"][1])
+        oh, ow = int(e["orig_hw"][0]), int(e["orig_hw"][1])
+        sy, sx = rh / max(1, oh), rw / max(1, ow)
+        boxes, classes = [], []
+        for d in e["detections"]:
+            if float(d["score"]) < self.min_score:
+                continue
+            cls = int(d["cls"])
+            if not 0 < cls < self.num_classes:
+                continue
+            x1, y1, x2, y2 = (float(v) for v in d["bbox"])
+            x1, x2 = x1 * sx, x2 * sx
+            y1, y2 = y1 * sy, y2 * sy
+            x1 = min(max(x1, 0.0), rw - 1)
+            x2 = min(max(x2, 0.0), rw - 1)
+            y1 = min(max(y1, 0.0), rh - 1)
+            y2 = min(max(y2, 0.0), rh - 1)
+            if x2 <= x1 or y2 <= y1:
+                continue
+            boxes.append((x1, y1, x2, y2))
+            classes.append(cls)
+        if not boxes:
+            return None
+        g = len(boxes)
+        classes = np.asarray(classes, np.int32)
+        overlaps = np.zeros((g, self.num_classes), np.float32)
+        overlaps[np.arange(g), classes] = 1.0
+        return {
+            "image": f"replay://{e['key']}",
+            "replay_npz": os.path.join(self.capture_dir, e["npz"]),
+            "replay_key": e["key"],
+            "replay_generation": int(e.get("generation", 0)),
+            "height": rh, "width": rw,
+            "boxes": np.asarray(boxes, np.float32),
+            "gt_classes": classes,
+            "gt_overlaps": overlaps,
+            "max_classes": classes.copy(),
+            "max_overlaps": np.ones((g,), np.float32),
+            "flipped": False,
+        }
+
+    def evaluate_detections(self, detections) -> dict:
+        raise NotImplementedError("replay shards carry pseudo-labels; "
+                                  "evaluate against a real test set")
+
+
+def load_replay_pixels(rec) -> np.ndarray:
+    """Load a replay record's uint8 HWC pixels, cropped to the raw extent.
+
+    Raises on a missing/corrupt/truncated shard so the loader's
+    bad-record substitution path handles it deterministically.
+    """
+    with np.load(rec["replay_npz"], allow_pickle=False) as npz:
+        px = np.asarray(npz[rec["replay_key"]])
+    if px.ndim != 3 or px.dtype != np.uint8:
+        raise ValueError(f"{rec['replay_npz']}:{rec['replay_key']}: "
+                         f"bad pixel payload {px.dtype}{px.shape}")
+    return np.ascontiguousarray(px[:rec["height"], :rec["width"]])

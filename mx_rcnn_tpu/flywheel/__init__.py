@@ -1,0 +1,20 @@
+"""Data flywheel: capture served requests, mine hard examples, replay them.
+
+Three stages close the serve -> train -> serve loop:
+
+- :mod:`capture` — a sampled, bounded request-log ring attached to the serve
+  engine, spilled as atomic JSONL+npz shards under ``--capture-dir``.
+- :mod:`miner` — ranks captured images by hardness (score entropy, threshold
+  disagreement, low max score) and writes a ``mined-<digest>.json`` manifest.
+- :mod:`loop` — orchestrates capture -> mine -> replay-train rounds; the
+  replay side lives in :class:`mx_rcnn_tpu.data.replay.ReplayDataset`.
+"""
+
+from .capture import CaptureOptions, NullCapture, NULL_CAPTURE, RequestCapture
+from .miner import mine_shards, write_manifest, load_manifest
+from .loop import FlywheelLoop
+
+__all__ = [
+    "CaptureOptions", "NullCapture", "NULL_CAPTURE", "RequestCapture",
+    "mine_shards", "write_manifest", "load_manifest", "FlywheelLoop",
+]

@@ -1,0 +1,310 @@
+"""Request capture: a sampled, bounded log of served images at the engine.
+
+The capture sink hangs off :class:`mx_rcnn_tpu.serve.engine.ServeEngine` the
+same way telemetry does: the engine holds :data:`NULL_CAPTURE` unless a
+capture dir was configured, and the hot path pays exactly one attribute check
+(``if self.capture.enabled:``) when capture is off.  The NULL sink *raises*
+if recorded into, so tests can pin the zero-overhead contract directly.
+
+What gets captured is PII-free by construction: the staged uint8 pixel
+buffer the model actually saw, its sidecar extents, the detection records
+the server returned, and per-image score statistics.  No client identity,
+no headers, no raw request bytes.
+
+Captured records accumulate in a bounded in-memory ring and spill to disk
+as shard pairs under the capture dir::
+
+    shard-000000.npz     # uint8 pixel arrays, one key per record
+    shard-000000.jsonl   # one JSON row per record: sidecars, stats, dets
+
+Both files are written via tmp + ``os.replace`` and the npz lands first, so
+a visible ``.jsonl`` implies its pixels exist.  A byte budget rotates the
+oldest shard pairs out.
+
+Fault injection (chaos tests): the env vars below name a shard index whose
+spill is corrupted/truncated after the atomic rename, simulating torn disks
+so the replay loader's bad-record substitution path can be pinned.
+"""
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from mx_rcnn_tpu import telemetry
+
+# Fault-injection env vars (package code owns the names + parsing; the
+# tests/faults.py composers only build env dicts from these).  The value is
+# the 0-based index of the shard to damage after it has been spilled.
+ENV_CORRUPT_SHARD = "MXR_FAULT_FLYWHEEL_CORRUPT_SHARD"
+ENV_TRUNCATE_SPILL = "MXR_FAULT_FLYWHEEL_TRUNCATE_SPILL"
+
+# Score thresholds used for the NMS-survivor disagreement signal: how many
+# detections survive at adjacent operating points.  A big falloff between
+# loose and strict thresholds marks a confused image.
+SCORE_BANDS = (0.3, 0.5, 0.7)
+
+# Detections stored per captured record (rows are score-sorted upstream).
+MAX_DETS_PER_RECORD = 100
+
+
+class NullCapture:
+    """Capture disabled: one attribute check on the hot path, nothing else.
+
+    ``record_batch`` raises so tests can pin that a disabled engine never
+    reaches the sink (the telemetry NULL-sink contract, enforced harder).
+    """
+
+    enabled = False
+
+    def record_batch(self, entries, generation):
+        raise RuntimeError("capture is disabled; engine must not record")
+
+    def metrics(self):
+        return {}
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+NULL_CAPTURE = NullCapture()
+
+
+@dataclass(frozen=True)
+class CaptureOptions:
+    capture_dir: str
+    sample_every: int = 1          # capture every Nth submitted request
+    ring_size: int = 256           # max records pending spill in memory
+    shard_records: int = 32        # records per spilled shard pair
+    byte_budget: int = 256 << 20   # rotate oldest shards beyond this
+
+
+def score_stats(records):
+    """Per-image hardness signals from the served detection records.
+
+    Returns a JSON-safe dict: detection count, max/mean score, normalized
+    score entropy, and survivor counts at each band in :data:`SCORE_BANDS`.
+    """
+    scores = np.asarray([float(r["score"]) for r in records], np.float64)
+    n = scores.size
+    stats = {"count": int(n), "max_score": 0.0, "mean_score": 0.0,
+             "entropy": 0.0,
+             "bands": {f"{t:.1f}": 0 for t in SCORE_BANDS}}
+    if n == 0:
+        return stats
+    stats["max_score"] = float(scores.max())
+    stats["mean_score"] = float(scores.mean())
+    if n > 1 and scores.sum() > 0:
+        p = scores / scores.sum()
+        p = p[p > 0]
+        stats["entropy"] = float(-(p * np.log(p)).sum() / np.log(n))
+    for t in SCORE_BANDS:
+        stats["bands"][f"{t:.1f}"] = int((scores >= t).sum())
+    return stats
+
+
+class RequestCapture:
+    """Bounded, sampled request log that spills atomic shard pairs.
+
+    Thread safety: ``record_batch`` runs on the engine's batch worker
+    thread; ``flush``/``metrics`` may be called from any thread.  A single
+    lock guards the ring and counters; spills happen synchronously on the
+    batch thread (capture-on is allowed to cost — only capture-OFF is
+    pinned to zero work).
+    """
+
+    enabled = True
+
+    def __init__(self, opts: CaptureOptions, env: Optional[dict] = None):
+        if opts.sample_every < 1:
+            raise ValueError("sample_every must be >= 1")
+        self.opts = opts
+        os.makedirs(opts.capture_dir, exist_ok=True)
+        env = os.environ if env is None else env
+        self._corrupt_shard = _env_index(env, ENV_CORRUPT_SHARD)
+        self._truncate_spill = _env_index(env, ENV_TRUNCATE_SPILL)
+        self._lock = threading.Lock()
+        self._pending = []            # [(meta dict, uint8 pixels)]
+        self._seen = 0                # submitted requests considered
+        self._rid = 0                 # monotonic record id
+        self._shard_idx = 0
+        self.counters = {"captured": 0, "sampled_out": 0, "dropped": 0,
+                         "spilled_bytes": 0, "shards": 0, "spill_errors": 0}
+
+    # ------------------------------------------------------------- record
+    def record_batch(self, entries, generation: int):
+        """Record a served batch.
+
+        ``entries``: iterable of ``(pixels, raw_hw, orig_hw, records)``
+        where ``pixels`` is the staged uint8 HWC buffer the model saw,
+        ``raw_hw`` its valid extent, ``orig_hw`` the pre-staging image
+        dims (detection boxes are in those original coordinates), and
+        ``records`` the detection records returned to the client.
+        """
+        spill = None
+        with self._lock:
+            for pixels, raw_hw, orig_hw, records in entries:
+                self._seen += 1
+                if (self._seen - 1) % self.opts.sample_every != 0:
+                    self.counters["sampled_out"] += 1
+                    continue
+                if len(self._pending) >= self.opts.ring_size:
+                    self.counters["dropped"] += 1
+                    continue
+                # a failed request (deadline, forward error) has no
+                # detections — capture it with an empty record list
+                records = records if records is not None else []
+                rid = self._rid
+                self._rid += 1
+                meta = {
+                    "rid": rid,
+                    "key": "r%08d" % rid,
+                    "bucket": [int(pixels.shape[0]), int(pixels.shape[1])],
+                    "raw_hw": [int(raw_hw[0]), int(raw_hw[1])],
+                    "orig_hw": [int(orig_hw[0]), int(orig_hw[1])],
+                    "generation": int(generation),
+                    "stats": score_stats(records),
+                    "detections": [
+                        {"cls": int(r["cls"]), "score": float(r["score"]),
+                         "bbox": [float(v) for v in r["bbox"]]}
+                        for r in records[:MAX_DETS_PER_RECORD]],
+                }
+                self._pending.append((meta, np.ascontiguousarray(
+                    pixels, dtype=np.uint8)))
+                self.counters["captured"] += 1
+            if len(self._pending) >= self.opts.shard_records:
+                spill = self._take_pending()
+        if spill:
+            self._spill(spill)
+
+    def _take_pending(self):
+        batch, self._pending = self._pending, []
+        return batch
+
+    # -------------------------------------------------------------- spill
+    def _spill(self, batch):
+        """Write one shard pair atomically; npz before jsonl."""
+        with self._lock:
+            idx = self._shard_idx
+            self._shard_idx += 1
+        # pid in the name: replica children sharing one capture dir must
+        # never clobber each other's shards
+        base = os.path.join(self.opts.capture_dir,
+                            "shard-%d-%06d" % (os.getpid(), idx))
+        tel = telemetry.get()
+        try:
+            npz_tmp = base + ".npz.tmp"
+            with open(npz_tmp, "wb") as fh:
+                np.savez(fh, **{m["key"]: px for m, px in batch})
+            os.replace(npz_tmp, base + ".npz")
+            rows = []
+            for meta, _ in batch:
+                row = dict(meta)
+                row["npz"] = os.path.basename(base + ".npz")
+                rows.append(json.dumps(row, sort_keys=True))
+            jsonl_tmp = base + ".jsonl.tmp"
+            with open(jsonl_tmp, "w") as fh:
+                fh.write("\n".join(rows) + "\n")
+            os.replace(jsonl_tmp, base + ".jsonl")
+        except OSError:
+            with self._lock:
+                self.counters["spill_errors"] += 1
+            tel.counter("flywheel/spill_error")
+            return
+        self._inject_fault(idx, base)
+        nbytes = os.path.getsize(base + ".npz") + os.path.getsize(
+            base + ".jsonl")
+        with self._lock:
+            self.counters["spilled_bytes"] += nbytes
+            self.counters["shards"] += 1
+        tel.counter("flywheel/captured", len(batch))
+        tel.counter("flywheel/spilled_bytes", nbytes)
+        tel.counter("flywheel/shards")
+        self._rotate(keep=base)
+
+    def _inject_fault(self, idx, base):
+        if self._corrupt_shard == idx:
+            with open(base + ".npz", "wb") as fh:
+                fh.write(b"not an npz: injected corruption\n")
+        if self._truncate_spill == idx:
+            size = os.path.getsize(base + ".npz")
+            with open(base + ".npz", "rb+") as fh:
+                fh.truncate(max(1, size // 2))
+
+    def _rotate(self, keep):
+        """Delete oldest shard pairs while the dir exceeds the budget."""
+        pairs = list_shards(self.opts.capture_dir)
+        total = sum(p["bytes"] for p in pairs)
+        for p in pairs:
+            if total <= self.opts.byte_budget:
+                break
+            if p["base"] == keep:
+                continue
+            for path in (p["base"] + ".jsonl", p["base"] + ".npz"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            total -= p["bytes"]
+
+    # ------------------------------------------------------------- public
+    def flush(self):
+        """Spill whatever is pending (partial shard included)."""
+        with self._lock:
+            batch = self._take_pending()
+        if batch:
+            self._spill(batch)
+
+    def close(self):
+        self.flush()
+
+    def metrics(self):
+        with self._lock:
+            out = dict(self.counters)
+        out["sample_every"] = self.opts.sample_every
+        return out
+
+
+def _env_index(env, name):
+    raw = env.get(name, "")
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a shard index, got {raw!r}")
+
+
+def list_shards(capture_dir):
+    """Complete shard pairs, oldest first: [{base, npz, jsonl, bytes}].
+
+    Ordered by jsonl mtime (then name): shard names carry the writer's
+    pid, so name order alone is not spill order across replicas.
+    """
+    out = []
+    try:
+        names = os.listdir(capture_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("shard-") and name.endswith(".jsonl")):
+            continue
+        base = os.path.join(capture_dir, name[:-len(".jsonl")])
+        if not os.path.exists(base + ".npz"):
+            continue
+        try:
+            st = os.stat(base + ".jsonl")
+            nbytes = os.path.getsize(base + ".npz") + st.st_size
+        except OSError:
+            continue
+        out.append({"base": base, "npz": base + ".npz",
+                    "jsonl": base + ".jsonl", "bytes": nbytes,
+                    "mtime": st.st_mtime})
+    out.sort(key=lambda p: (p["mtime"], p["base"]))
+    return out

@@ -1,0 +1,63 @@
+"""Flywheel round orchestration: capture dir -> mined manifest -> train.
+
+A round mines whatever the serving fleet has spilled so far, then (when a
+train command is configured) launches the replay-mixed training as a
+subprocess with ``--replay-manifest`` appended.  The loop does NOT manage
+serving: replicas already follow checkpoints via ``--watch-checkpoints``
+(PR-8 canary/rollback), so a training run that saves a checkpoint closes
+the loop on its own.  Round/generation progress is published as
+``flywheel/*`` telemetry.
+"""
+
+import subprocess
+from typing import Optional, Sequence
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.logger import logger
+
+from .miner import mine_shards, write_manifest
+
+
+class FlywheelLoop:
+    def __init__(self, capture_dir: str, top_k: int = 64,
+                 min_label_score: float = 0.3,
+                 out_dir: Optional[str] = None,
+                 train_cmd: Optional[Sequence[str]] = None):
+        self.capture_dir = capture_dir
+        self.top_k = top_k
+        self.min_label_score = min_label_score
+        self.out_dir = out_dir
+        self.train_cmd = list(train_cmd) if train_cmd else None
+
+    def run_round(self, round_idx: int = 0) -> dict:
+        """Mine once, optionally train once; returns the round summary."""
+        tel = telemetry.get()
+        entries, scanned, skipped = mine_shards(
+            self.capture_dir, top_k=self.top_k,
+            min_label_score=self.min_label_score)
+        result = {"round": round_idx, "mined": len(entries),
+                  "scanned": scanned, "skipped": skipped,
+                  "manifest": None, "train_rc": None}
+        if not entries:
+            logger.info("flywheel round %d: nothing mined (%d scanned)",
+                        round_idx, scanned)
+            return result
+        manifest = write_manifest(
+            self.capture_dir, entries, scanned, self.top_k,
+            out_dir=self.out_dir, min_label_score=self.min_label_score)
+        result["manifest"] = manifest
+        tel.gauge("flywheel/round", round_idx)
+        logger.info("flywheel round %d: mined %d/%d -> %s",
+                    round_idx, len(entries), scanned, manifest)
+        if self.train_cmd:
+            cmd = self.train_cmd + ["--replay-manifest", manifest]
+            proc = subprocess.run(cmd)
+            result["train_rc"] = proc.returncode
+            if proc.returncode != 0:
+                tel.counter("flywheel/train_failed")
+                logger.error("flywheel round %d: train rc=%d",
+                             round_idx, proc.returncode)
+        return result
+
+    def run(self, rounds: int = 1) -> list:
+        return [self.run_round(i) for i in range(rounds)]

@@ -1,0 +1,143 @@
+"""Hard-example mining over captured request shards.
+
+Reads the ``shard-*.jsonl`` rows a :class:`~mx_rcnn_tpu.flywheel.capture.
+RequestCapture` spilled, scores each record's hardness, and writes the
+top-K as an atomic ``mined-<digest>.json`` manifest with full provenance
+(source shard, request id, model generation that served it).
+
+Hardness combines the three signals the capture stage recorded:
+
+- **entropy** — normalized detection-score entropy; flat score mass means
+  the model could not separate its hypotheses.
+- **disagreement** — NMS-survivor falloff across adjacent score
+  thresholds; many loose survivors that die at the strict threshold mark
+  borderline detections.
+- **low max score** — ``1 - max_score``; the model's best guess is weak.
+
+The manifest rename is the commit point: a SIGTERM mid-mine leaves only a
+``.tmp`` file behind, never a partial manifest (pinned in tests via
+:data:`ENV_MINE_PAUSE_S`, which sleeps between write and rename).
+"""
+
+import hashlib
+import json
+import os
+import time
+
+from mx_rcnn_tpu import telemetry
+
+from .capture import SCORE_BANDS, list_shards
+
+# Test hook: sleep this many seconds between writing the tmp manifest and
+# the atomic rename, widening the window a SIGTERM-atomicity test needs.
+ENV_MINE_PAUSE_S = "MXR_FLYWHEEL_MINE_PAUSE_S"
+
+# Signal weights; entropy and disagreement dominate, low-max breaks ties.
+W_ENTROPY = 1.0
+W_DISAGREE = 1.0
+W_LOW_MAX = 0.5
+
+MANIFEST_SCHEMA = "mxr_mined_manifest"
+
+
+def hardness(stats):
+    """Scalar hardness of one captured record from its score stats."""
+    bands = stats.get("bands", {})
+    loose = bands.get(f"{SCORE_BANDS[0]:.1f}", 0)
+    strict = bands.get(f"{SCORE_BANDS[-1]:.1f}", 0)
+    disagree = (loose - strict) / max(1, loose)
+    entropy = float(stats.get("entropy", 0.0))
+    low_max = 1.0 - float(stats.get("max_score", 0.0))
+    score = W_ENTROPY * entropy + W_DISAGREE * disagree + W_LOW_MAX * low_max
+    return score, {"entropy": entropy, "disagreement": disagree,
+                   "low_max": low_max}
+
+
+def mine_shards(capture_dir, top_k=64, min_label_score=0.3):
+    """Scan shard rows, rank by hardness, return (entries, scanned, skipped).
+
+    Records with no detection at or above ``min_label_score`` carry no
+    usable pseudo-label and are skipped (counted, not errored).  Rows that
+    fail to parse are skipped the same way — a torn jsonl must not kill
+    the mine.
+    """
+    tel = telemetry.get()
+    scanned = skipped = 0
+    scored = []
+    for shard in list_shards(capture_dir):
+        with open(shard["jsonl"]) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                scanned += 1
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    skipped += 1
+                    tel.counter("flywheel/skipped_bad_row")
+                    continue
+                dets = row.get("detections", [])
+                if not any(d["score"] >= min_label_score for d in dets):
+                    skipped += 1
+                    tel.counter("flywheel/skipped_unlabeled")
+                    continue
+                score, signals = hardness(row.get("stats", {}))
+                scored.append((score, {
+                    "shard": os.path.basename(shard["jsonl"]),
+                    "npz": row["npz"],
+                    "key": row["key"],
+                    "rid": row["rid"],
+                    "hardness": score,
+                    "signals": signals,
+                    "generation": row.get("generation", 0),
+                    "bucket": row["bucket"],
+                    "raw_hw": row["raw_hw"],
+                    "orig_hw": row["orig_hw"],
+                    "detections": dets,
+                }))
+    # stable, deterministic order: hardness desc, then rid asc
+    scored.sort(key=lambda se: (-se[0], se[1]["rid"]))
+    entries = [e for _, e in scored[:top_k]]
+    tel.counter("flywheel/mined", len(entries))
+    return entries, scanned, skipped
+
+
+def write_manifest(capture_dir, entries, scanned, top_k,
+                   out_dir=None, min_label_score=None):
+    """Atomically write ``mined-<digest>.json``; returns its path.
+
+    The digest covers the entry provenance, so re-mining identical
+    captures lands on the same filename (idempotent rounds).
+    """
+    doc = {
+        "schema": MANIFEST_SCHEMA,
+        "version": 1,
+        "capture_dir": os.path.abspath(capture_dir),
+        "top_k": int(top_k),
+        "total_scanned": int(scanned),
+        "min_label_score": min_label_score,
+        "entries": entries,
+    }
+    payload = json.dumps(doc, sort_keys=True, indent=1)
+    digest = hashlib.sha256(json.dumps(
+        [(e["npz"], e["key"]) for e in entries]).encode()).hexdigest()[:12]
+    out_dir = capture_dir if out_dir is None else out_dir
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"mined-{digest}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(payload)
+    pause = float(os.environ.get(ENV_MINE_PAUSE_S, "0") or 0)
+    if pause > 0:
+        time.sleep(pause)
+    os.replace(tmp, path)
+    return path
+
+
+def load_manifest(path):
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != MANIFEST_SCHEMA:
+        raise ValueError(f"{path}: not a {MANIFEST_SCHEMA} document")
+    return doc

@@ -162,10 +162,12 @@ class ServeFuture:
 
 class _Request:
     __slots__ = ("image", "im_info", "t_enqueue", "deadline", "bucket",
-                 "future", "raw_hw", "ratio")
+                 "future", "raw_hw", "ratio", "orig_hw", "staged",
+                 "staged_hw")
 
     def __init__(self, image, im_info, t_enqueue, deadline, bucket=None,
-                 raw_hw=None, ratio=None):
+                 raw_hw=None, ratio=None, orig_hw=None, staged=None,
+                 staged_hw=None):
         self.image = image          # bucket-padded network input, or (in
         # serve_e2e mode) the STAGED raw uint8 bucket array
         self.im_info = im_info
@@ -176,6 +178,13 @@ class _Request:
         # them inside the fused program; None on the legacy path
         self.raw_hw = raw_hw        # (2,) int32 [h, w] of the raw image
         self.ratio = ratio          # () float32 output→input sampling ratio
+        # flywheel capture sidecars: pre-staging (h, w) of the submitted
+        # image (detections are in those coordinates), plus — legacy path
+        # with capture on only — a staged uint8 copy and its valid extent
+        # (in e2e mode ``image`` already IS the staged buffer)
+        self.orig_hw = orig_hw
+        self.staged = staged
+        self.staged_hw = staged_hw
         self.future = ServeFuture()
 
 
@@ -249,6 +258,12 @@ class ServeEngine:
         self._bucket_delay_ms: Dict[Tuple[int, int], float] = {}
         self._admit_limit: Optional[int] = None
         self.controller = None  # set by SLOController.start()
+        # flywheel request capture: NULL sink unless a capture dir was
+        # configured (serve.py --capture-dir attaches a RequestCapture).
+        # Same contract as telemetry — capture-off costs one attribute
+        # check per batch, and the NULL sink raises if recorded into.
+        from mx_rcnn_tpu.flywheel.capture import NULL_CAPTURE
+        self.capture = NULL_CAPTURE
 
     # -- lifecycle -------------------------------------------------------
 
@@ -281,6 +296,8 @@ class ServeEngine:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+        if self.capture.enabled:
+            self.capture.close()
 
     # -- readiness / drain (replica supervision + hot reload) ------------
 
@@ -450,6 +467,20 @@ class ServeEngine:
         prep_s = time.perf_counter() - t_prep
         self.hists["serve/host_prep"].observe(prep_s)
         tel.observe("serve/host_prep", prep_s)
+        orig_hw = (int(image.shape[0]), int(image.shape[1]))
+        staged = staged_hw = None
+        if self.capture.enabled and not self.opts.serve_e2e:
+            # capture-on, legacy path: also stage the raw uint8 so the
+            # flywheel logs the pixels the PII-free contract allows (the
+            # e2e path's ``prepared`` already IS that buffer).  Runs on
+            # the caller's thread, like the prep itself.
+            raw8 = np.asarray(image)
+            if raw8.dtype != np.uint8:
+                raw8 = np.clip(raw8, 0, 255).astype(np.uint8)
+            staged, staged_hw, _, _ = stage_raw_to_bucket(
+                raw8, self._scale,
+                max(self.cfg.network.IMAGE_STRIDE,
+                    self.cfg.network.RPN_FEAT_STRIDE))
         # route on the LOGICAL bucket (pre-s2d padded shape) — under
         # HOST_S2D the prepared array is (H/2, W/2, 12), but orientation
         # and program identity are the bucket's, and /metrics should name
@@ -460,7 +491,8 @@ class ServeEngine:
             deadline_ms = self.opts.deadline_ms
         deadline = now + deadline_ms / 1e3 if deadline_ms > 0 else None
         req = _Request(prepared, im_info, now, deadline, bucket=key,
-                       raw_hw=raw_hw, ratio=ratio)
+                       raw_hw=raw_hw, ratio=ratio, orig_hw=orig_hw,
+                       staged=staged, staged_hw=staged_hw)
         with self._cond:
             if self._stop:
                 self.counters["rejected"] += 1
@@ -634,6 +666,14 @@ class ServeEngine:
                 self.counters[k] = self.counters.get(k, 0) + v
         tel.counter("serve/batches")
         tel.counter("serve/images", len(reqs))
+        if self.capture.enabled:
+            entries = []
+            for r in reqs:
+                px, hw = ((r.image, r.raw_hw) if self.opts.serve_e2e
+                          else (r.staged, r.staged_hw))
+                if px is not None:
+                    entries.append((px, hw, r.orig_hw, r.future._result))
+            self.capture.record_batch(entries, self.generation)
 
     def _note_first_dispatch(self, shape, kind: str, tel) -> bool:
         """First-seen accounting for one batch's program (registry when
@@ -776,6 +816,8 @@ class ServeEngine:
         out["latency"] = latency
         out["policy"] = self.policy()
         out["dtype"] = self._dtype
+        if self.capture.enabled:
+            out["flywheel"] = self.capture.metrics()
         if self.registry is not None:
             out["compile"] = self.registry.snapshot()
         ctrl = self.controller

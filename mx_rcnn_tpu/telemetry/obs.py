@@ -385,6 +385,20 @@ def engine_summary(engine) -> dict:
         if key in m.get("counters", {}):
             counters[name] = m["counters"][key]
     gauges = dict(base.get("gauges") or {})
+    # flywheel capture state (engine.metrics() carries it only when a
+    # RequestCapture is attached): counters as flywheel/*, plus the
+    # serving generation — both needed by the smoke script's "did the
+    # loop advance?" probe on the Prometheus path
+    fly = m.get("flywheel") or {}
+    for key, v in fly.items():
+        if key == "sample_every":
+            gauges["flywheel/sample_every"] = {
+                "count": 1, "mean": v, "min": v, "max": v, "last": v}
+        else:
+            counters[f"flywheel/{key}"] = v
+    gen = m.get("generation", 0)
+    gauges.setdefault("serve/generation", {
+        "count": 1, "mean": gen, "min": gen, "max": gen, "last": gen})
     depth = m.get("queue_depth", 0)
     live = gauges.get("serve/queue_depth", {})
     gauges["serve/queue_depth"] = {

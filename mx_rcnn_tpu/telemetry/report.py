@@ -70,6 +70,23 @@ FABRIC_COUNTERS = (
     "fabric/reload_rollback",
 )
 
+# the data flywheel's loop progress (flywheel/capture.py + miner.py +
+# the loader's replay mixing): rendered as their own section — zeros
+# included — whenever the stream carries any flywheel/* event, so "did
+# traffic actually capture, mine, and replay into training?" is one
+# greppable block (script/flywheel_smoke.sh reads it)
+FLYWHEEL_COUNTERS = (
+    "flywheel/captured",
+    "flywheel/spilled_bytes",
+    "flywheel/shards",
+    "flywheel/spill_error",
+    "flywheel/mined",
+    "flywheel/skipped_unlabeled",
+    "flywheel/skipped_bad_row",
+    "flywheel/replayed",
+    "flywheel/train_failed",
+)
+
 
 def event_files(paths: Iterable[str]) -> List[str]:
     """Expand run dirs to their per-rank event files; pass files through."""
@@ -212,6 +229,8 @@ def render_table(summary: dict) -> str:
         k.startswith("serve/") for k in summary.get("spans", {}))
     fabric = any(k.startswith("fabric/") for k in counters) or any(
         k.startswith("fabric/") for k in summary.get("gauges", {}))
+    flywheel = any(k.startswith("flywheel/") for k in counters) or any(
+        k.startswith("flywheel/") for k in summary.get("gauges", {}))
     if counters:
         lines.append("")
         lines.append(f"{'counter':<34}{'total':>8}")
@@ -228,6 +247,8 @@ def render_table(summary: dict) -> str:
                 continue  # ditto serve health
             if fabric and name in FABRIC_COUNTERS:
                 continue  # ditto fabric health
+            if flywheel and name in FLYWHEEL_COUNTERS:
+                continue  # ditto the flywheel table
             lines.append(f"{name:<34}{v:>8}")
         lines.append("")
         lines.append(f"{'recovery event':<34}{'total':>8}")
@@ -244,6 +265,11 @@ def render_table(summary: dict) -> str:
             lines.append("")
             lines.append(f"{'fabric health':<34}{'total':>8}")
             for name in FABRIC_COUNTERS:
+                lines.append(f"{name:<34}{counters.get(name, 0):>8}")
+        if flywheel:
+            lines.append("")
+            lines.append(f"{'flywheel':<34}{'total':>8}")
+            for name in FLYWHEEL_COUNTERS:
                 lines.append(f"{name:<34}{counters.get(name, 0):>8}")
     gauges = summary.get("gauges", {})
     if gauges:

@@ -127,6 +127,22 @@ def add_common_args(parser: argparse.ArgumentParser, train: bool = True):
                                  "--auto-tune` (k steps/dispatch, loader "
                                  "workers, prefetch depth, device-prep); "
                                  "explicit flags win per field")
+        # data flywheel replay (ISSUE 13): mix mined serving captures
+        # into the epoch plan (data/replay.py); the mix is drawn from the
+        # loader's plan RNG, so --auto-resume reproduces it bit-for-bit
+        parser.add_argument("--replay-manifest", default="",
+                            dest="replay_manifest",
+                            help="mined-<digest>.json manifest from "
+                                 "flywheel.py mine; enables replay mixing")
+        parser.add_argument("--replay-ratio", type=float, default=0.25,
+                            dest="replay_ratio",
+                            help="fraction of each batch's slots "
+                                 "substituted with replay records "
+                                 "(in [0, 1); only with --replay-manifest)")
+        parser.add_argument("--replay-thresh", type=float, default=0.5,
+                            dest="replay_thresh",
+                            help="min served detection score kept as a "
+                                 "replay pseudo-label")
         # fault tolerance (train/resilience.py): --save-every-n-steps,
         # --auto-resume, --nan-policy on every fit-based driver
         add_resilience_args(parser)
@@ -299,6 +315,29 @@ def get_train_roidb(imdb, cfg: Config, roidb=None):
     # AFTER filtering: the corrupted record must survive into the epoch
     # plan for script/fault_smoke.sh to exercise the loader's isolation
     return inject_roidb_faults(imdb.filter_roidb(roidb))
+
+
+def replay_from_args(args, cfg: Config):
+    """``--replay-manifest`` → (replay_roidb, replay_ratio) loader kwargs.
+
+    Returns ``(None, 0.0)`` when replay is off or the manifest mined
+    nothing usable (an empty round must not fail the training run)."""
+    manifest = getattr(args, "replay_manifest", "")
+    if not manifest:
+        return None, 0.0
+    from mx_rcnn_tpu.data.replay import ReplayDataset
+
+    ds = ReplayDataset(manifest, cfg.NUM_CLASSES,
+                       min_score=getattr(args, "replay_thresh", 0.5))
+    roidb = ds.gt_roidb()
+    if not roidb:
+        logger.warning("replay manifest %s yielded no usable records "
+                       "(all pseudo-labels below --replay-thresh?) — "
+                       "training without replay", manifest)
+        return None, 0.0
+    logger.info("replay: mixing %d mined record(s) from %s at ratio %.2f",
+                len(roidb), manifest, args.replay_ratio)
+    return roidb, float(args.replay_ratio)
 
 
 def init_dist_from_args(args) -> tuple:

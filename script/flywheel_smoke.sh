@@ -1,0 +1,202 @@
+#!/usr/bin/env bash
+# Data-flywheel smoke (ISSUE 13, CPU-friendly): the serve→train→serve
+# loop end to end through the real CLI drivers.
+#
+#   1. Serve — a single synthetic-weight server with request capture ON
+#      (--capture-dir) and --watch-checkpoints armed on an empty prefix.
+#      scripts/loadgen.py drives traffic with --capture-check: the
+#      /metrics flywheel captured-delta must match 2xx submits ×
+#      sample rate (the silent-capture-loss gate).
+#   2. Mine — flywheel.py mine ranks the spilled shards by hardness and
+#      writes the mined-<digest>.json manifest.
+#   3. Replay train — train_end2end.py --synthetic with
+#      --replay-manifest/--replay-ratio mixes the mined captures into a
+#      short run that saves a mid-epoch step checkpoint AND the epoch
+#      save, directly into the server's watched prefix.
+#   4. Reload — the live server's CheckpointWatcher picks the save up on
+#      its own; the /metrics generation must advance (canary passed,
+#      replay-trained weights serving).
+#
+# The run emits FLYWHEEL_r01.json (schema mxr_flywheel_report) scored by
+# scripts/perf_gate.py floor rows: mined_fraction > 0 and the reload
+# generation strictly advanced — loop closure as a property of the
+# build.  The serve telemetry stream must render the "flywheel" section
+# in scripts/telemetry_report.py.
+#
+#   bash script/flywheel_smoke.sh
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${FLYWHEEL_SMOKE_DIR:-/tmp/mxr_flywheel_smoke}
+rm -rf "$dir"
+mkdir -p "$dir"
+cap="$dir/capture"
+ckpt="$dir/ckpt"
+tels="$dir/tel_serve"
+mkdir -p "$ckpt"
+
+PORT=$(python - <<'EOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+EOF
+)
+
+# ---- act 1: serve with capture on, watcher armed -------------------------
+echo "flywheel_smoke: [1/4] serve with --capture-dir + --capture-check"
+python serve.py --network resnet50 --synthetic --port "$PORT" \
+  --serve-batch 2 --max-delay-ms 20 --max-queue 32 --deadline-ms 120000 \
+  --cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)" \
+  --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32 \
+  --capture-dir "$cap" --capture-shard-records 8 \
+  --watch-checkpoints "$ckpt" --watch-interval-s 1 \
+  --telemetry-dir "$tels" &
+pid=$!
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+
+python - "$PORT" "$pid" <<'EOF'
+import os, sys, time
+from mx_rcnn_tpu.serve import tcp_http_request
+port, pid = int(sys.argv[1]), int(sys.argv[2])
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("server exited before becoming ready")
+    try:
+        status, _ = tcp_http_request("127.0.0.1", port, "GET", "/readyz",
+                                     timeout=5)
+        if status == 200:
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1)
+sys.exit("server never became ready")
+EOF
+
+# --capture-check: captured delta must equal the 24 2xx submits at
+# sample rate 1 (silent capture loss exits 1 here)
+python scripts/loadgen.py --port "$PORT" --n 24 --rate 20 \
+  --short 80 --long 110 --assert-2xx --capture-check \
+  | tee "$dir/traffic.json"
+
+# snapshot captured count + pre-reload generation for the report
+python - "$PORT" "$dir" <<'EOF'
+import json, sys
+from mx_rcnn_tpu.serve import tcp_http_request
+status, m = tcp_http_request("127.0.0.1", int(sys.argv[1]), "GET",
+                             "/metrics", timeout=10)
+assert status == 200, m
+fw = m["flywheel"]
+assert fw["captured"] >= 24, fw        # warmup + the loadgen burst
+assert fw["shards"] >= 1, fw           # spills already on disk
+snap = {"captured": fw["captured"], "generation_before": m["generation"]}
+json.dump(snap, open(f"{sys.argv[2]}/snap.json", "w"))
+print(f"flywheel_smoke: capture OK ({fw['captured']} captured, "
+      f"{fw['shards']} shards, generation={m['generation']})")
+EOF
+
+# ---- act 2: mine the shards into a manifest ------------------------------
+echo "flywheel_smoke: [2/4] mine hard examples"
+python flywheel.py mine --capture-dir "$cap" --top-k 16 \
+  --min-label-score 0.0 --telemetry-dir "$dir/tel_mine" \
+  | tee "$dir/mine.json"
+manifest=$(python - "$dir/mine.json" <<'EOF'
+import json, sys
+doc = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert doc["mined"] > 0, f"nothing mined: {doc}"
+print(doc["manifest"])
+EOF
+)
+
+# ---- act 3: short replay-mixed training into the watched prefix ----------
+echo "flywheel_smoke: [3/4] replay-mixed training -> $ckpt"
+python train_end2end.py --network resnet50 --synthetic \
+  --synthetic_images 16 \
+  --cfg "tpu__SCALES=((64,96),)" --cfg "tpu__MAX_GT=4" \
+  --cfg "network__ANCHOR_SCALES=(2,4)" \
+  --cfg "TRAIN__RPN_PRE_NMS_TOP_N=200" \
+  --cfg "TRAIN__RPN_POST_NMS_TOP_N=32" \
+  --cfg "TRAIN__BATCH_ROIS=16" \
+  --prefix "$ckpt" --end_epoch 1 --num-steps 6 --frequent 2 \
+  --save-every-n-steps 2 \
+  --replay-manifest "$manifest" --replay-ratio 0.5 --replay-thresh 0.0 \
+  --telemetry-dir "$dir/tel_train"
+
+# ---- act 4: the live server hot-reloads the save on its own --------------
+echo "flywheel_smoke: [4/4] watcher-driven hot reload"
+python - "$PORT" "$dir" <<'EOF'
+import json, sys, time
+from mx_rcnn_tpu.serve import tcp_http_request
+port, d = int(sys.argv[1]), sys.argv[2]
+snap = json.load(open(f"{d}/snap.json"))
+deadline = time.time() + 180
+gen, stable = None, 0
+while True:
+    try:
+        status, m = tcp_http_request("127.0.0.1", port, "GET", "/metrics",
+                                     timeout=10)
+        rstatus, _ = tcp_http_request("127.0.0.1", port, "GET", "/readyz",
+                                      timeout=10)
+    except OSError:
+        sys.exit("server died during the reload window")
+    assert status == 200, m
+    # the training run saved several step checkpoints AND the epoch: the
+    # watcher may roll more than one reload.  Wait for a generation
+    # advance, then for the watcher to go QUIET — ready and generation
+    # stable across a window comfortably longer than --watch-interval-s,
+    # so the clean-serve probe below can't race a draining swap.
+    if m["generation"] > snap["generation_before"] and rstatus == 200 \
+            and m["generation"] == gen:
+        stable += 1
+        if stable >= 8:
+            break
+    else:
+        stable = 0
+    gen = m["generation"]
+    if time.time() > deadline:
+        sys.exit(f"generation never advanced past "
+                 f"{snap['generation_before']} and settled: {gen}")
+    time.sleep(1)
+snap["generation_after"] = m["generation"]
+json.dump(snap, open(f"{d}/snap.json", "w"))
+print(f"flywheel_smoke: reload OK (generation "
+      f"{snap['generation_before']} -> {snap['generation_after']})")
+EOF
+
+# the reloaded server still serves clean
+python scripts/loadgen.py --port "$PORT" --n 6 --rate 10 \
+  --short 80 --long 110 --assert-2xx >/dev/null
+kill -TERM "$pid"
+wait "$pid" || true
+trap - EXIT
+
+# ---- report + perf gate --------------------------------------------------
+python - "$dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+snap = json.load(open(f"{d}/snap.json"))
+mine = json.loads(open(f"{d}/mine.json").read().strip().splitlines()[-1])
+doc = {
+    "schema": "mxr_flywheel_report", "version": 1,
+    "captured": snap["captured"],
+    "mined": mine["mined"],
+    "scanned": mine["scanned"],
+    "generation_before": snap["generation_before"],
+    "generation_after": snap["generation_after"],
+}
+with open(f"{d}/FLYWHEEL_r01.json", "w") as f:
+    json.dump(doc, f, indent=1, sort_keys=True)
+print(f"flywheel_smoke: report OK (mined {doc['mined']}/{doc['captured']} "
+      f"captured, generation {doc['generation_before']} -> "
+      f"{doc['generation_after']})")
+EOF
+python scripts/perf_gate.py --check-format "$dir"/FLYWHEEL_r*.json
+python scripts/perf_gate.py --dir "$dir"
+
+# the serve telemetry stream renders the flywheel table
+python scripts/telemetry_report.py "$tels" | tee "$dir/report.txt"
+grep -E '^flywheel/captured +[1-9]' "$dir/report.txt"
+grep -E '^flywheel/shards +[1-9]' "$dir/report.txt"
+echo "flywheel_smoke: OK"

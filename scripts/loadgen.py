@@ -67,6 +67,12 @@ line/report row gains ``member_share``, the fraction of the scenario's
 routed requests each member served (the routing-balance evidence
 script/fabric_smoke.sh and the FABRIC_r*.json gate read), plus
 ``fabric_members``, the live member count at scenario end.
+
+Capture check (ISSUE 13): with ``--capture-check`` the target's
+``/metrics`` flywheel ``captured`` counter is snapshotted around the
+whole run and the delta must match ``2xx submits / sample_every``
+within ``--capture-tolerance`` (exit 1 otherwise) — the smoke-script
+guard against silent capture loss.
 """
 
 import argparse
@@ -130,6 +136,17 @@ def parse_args(argv=None):
                     help="target is a fabric router: diff its /metrics "
                          "per-member request counters around each "
                          "scenario and report member_share (TCP only)")
+    ap.add_argument("--capture-check", action="store_true",
+                    dest="capture_check",
+                    help="diff the server's /metrics flywheel captured "
+                         "counter around the run and exit 1 unless it "
+                         "matches 2xx submits / capture sample rate "
+                         "within --capture-tolerance (catches silent "
+                         "capture loss in smoke scripts)")
+    ap.add_argument("--capture-tolerance", type=float, default=0.1,
+                    dest="capture_tolerance",
+                    help="--capture-check: allowed relative deviation "
+                         "of captured-delta from the expected count")
     return ap.parse_args(argv)
 
 
@@ -194,6 +211,54 @@ def fabric_member_requests(host, port, timeout=10.0):
     members = doc.get("fabric", {}).get("members", {})
     return {name: m.get("requests", 0) for name, m in members.items()
             if isinstance(m, dict)}
+
+
+def flywheel_capture_stats(args, timeout=10.0):
+    """``{"captured": n, "sample_every": k}`` from the target server's
+    ``/metrics`` flywheel section (TCP or Unix socket); ``{}`` when the
+    endpoint is unreachable or capture is not enabled there."""
+    try:
+        if args.unix_socket:
+            status, doc = unix_http_request(args.unix_socket, "GET",
+                                            "/metrics", timeout=timeout)
+        else:
+            conn = http.client.HTTPConnection(args.host, args.port,
+                                              timeout=timeout)
+            try:
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                status, doc = resp.status, json.loads(resp.read())
+            finally:
+                conn.close()
+    except (OSError, ValueError):
+        return {}
+    if status != 200 or not isinstance(doc, dict):
+        return {}
+    fw = doc.get("flywheel")
+    if not isinstance(fw, dict):
+        return {}
+    return {"captured": int(fw.get("captured", 0)),
+            "sample_every": max(int(fw.get("sample_every", 1)), 1)}
+
+
+def capture_check_failure(before, after, ok_submits, tolerance):
+    """None when the server's captured-count delta matches
+    ``ok_submits / sample_every`` within ``tolerance`` (relative, with
+    ±1 absolute slack for stride phase), else the stderr failure line.
+    Missing flywheel sections fail loudly — a smoke script passing
+    ``--capture-check`` against a capture-off server is itself a bug."""
+    if not after:
+        return ("loadgen: --capture-check failed: target exposes no "
+                "flywheel section on /metrics (capture not enabled?)")
+    sample_every = after["sample_every"]
+    delta = after["captured"] - (before.get("captured", 0) if before else 0)
+    expected = ok_submits / sample_every
+    slack = max(1.0, tolerance * expected)
+    if abs(delta - expected) > slack:
+        return (f"loadgen: --capture-check failed: captured delta {delta} "
+                f"vs expected {expected:.1f} ({ok_submits} 2xx submits / "
+                f"sample_every {sample_every}, tolerance ±{slack:.1f})")
+    return None
 
 
 def member_share(before: dict, after: dict) -> dict:
@@ -318,6 +383,8 @@ def main(argv=None):
     scenarios = args.scenarios or [None]
     report_rows = []
     all_results = []
+    capture_before = (flywheel_capture_stats(args, timeout=args.timeout)
+                      if args.capture_check else None)
     for idx, scenario in enumerate(scenarios):
         docs = make_payloads(args, seed=args.seed + idx,
                              size_mix=(scenario == "size-mix"))
@@ -350,6 +417,15 @@ def main(argv=None):
                "scenarios": report_rows}
         with open(args.report, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
+
+    if args.capture_check:
+        after = flywheel_capture_stats(args, timeout=args.timeout)
+        ok = sum(1 for r in all_results if 200 <= r[0] < 300)
+        msg = capture_check_failure(capture_before, after, ok,
+                                    args.capture_tolerance)
+        if msg is not None:
+            print(msg, file=sys.stderr)
+            sys.exit(1)
 
     if args.assert_2xx:
         msg = assert_2xx_failure(all_results)

@@ -76,6 +76,11 @@ EVAL_SPEEDUP_FLOOR = 1.0
 # (submit-thread timing is scheduler-noisy on a shared CI box).
 READBACK_ABS_SLACK_BYTES = 1024.0
 HOST_PREP_ABS_SLACK_MS = 2.0
+# data-flywheel loop closure (mxr_flywheel_report): the smoke must mine
+# SOME nonzero fraction of what it captured, and the replica must have
+# hot-reloaded at least one replay-trained checkpoint generation
+FLYWHEEL_MINED_FRACTION_FLOOR = 0.01
+FLYWHEEL_GENERATION_FLOOR = 1.0
 
 
 def slo_report_rows(doc: dict) -> list:
@@ -164,6 +169,33 @@ def fabric_report_rows(doc: dict) -> list:
     return rows
 
 
+def flywheel_report_rows(doc: dict) -> list:
+    """Expand an ``mxr_flywheel_report`` (script/flywheel_smoke.sh) into
+    FLOOR rows — loop closure is a property of the build, scored on the
+    newest run alone: some fraction of the captured traffic must have
+    mined into the replay manifest, and the serving generation must have
+    advanced when the replay-trained checkpoint hot-reloaded."""
+    rows = []
+    captured = doc.get("captured")
+    mined = doc.get("mined")
+    if (isinstance(captured, (int, float)) and captured > 0
+            and isinstance(mined, (int, float))):
+        rows.append({"metric": "flywheel_mined_fraction",
+                     "value": round(mined / captured, 4),
+                     "unit": "fraction",
+                     "floor": doc.get("mined_fraction_floor",
+                                      FLYWHEEL_MINED_FRACTION_FLOOR)})
+    before = doc.get("generation_before")
+    after = doc.get("generation_after")
+    if isinstance(before, (int, float)) and isinstance(after, (int, float)):
+        rows.append({"metric": "flywheel_reload_generations",
+                     "value": float(after - before),
+                     "unit": "generations",
+                     "floor": doc.get("generation_floor",
+                                      FLYWHEEL_GENERATION_FLOOR)})
+    return rows
+
+
 def load_rows(path: str) -> list:
     """Extract metric rows from one trajectory artifact.  Shapes seen in
     the wild: the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
@@ -179,6 +211,8 @@ def load_rows(path: str) -> list:
         return replica_report_rows(doc)
     if isinstance(doc, dict) and doc.get("schema") == "mxr_fabric_report":
         return fabric_report_rows(doc)
+    if isinstance(doc, dict) and doc.get("schema") == "mxr_flywheel_report":
+        return flywheel_report_rows(doc)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return startup_rows([doc["parsed"]])
     if isinstance(doc, dict) and "metric" in doc:
@@ -355,11 +389,11 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*",
                     help="trajectory files (default: --dir/BENCH_r*.json "
                          "+ --dir/SLO_r*.json + --dir/REPLICA_r*.json + "
-                         "--dir/FABRIC_r*.json)")
+                         "--dir/FABRIC_r*.json + --dir/FLYWHEEL_r*.json)")
     ap.add_argument("--dir", default=".",
                     help="where to glob BENCH_r*.json / SLO_r*.json / "
-                         "REPLICA_r*.json / FABRIC_r*.json when no paths "
-                         "given")
+                         "REPLICA_r*.json / FABRIC_r*.json / "
+                         "FLYWHEEL_r*.json when no paths given")
     ap.add_argument("--threshold", type=float, default=GATE_THRESHOLD,
                     help="allowed fractional drop vs the best prior run "
                          "(default 0.10)")
@@ -373,7 +407,8 @@ def main(argv=None) -> int:
         sorted(glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "SLO_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "REPLICA_r*.json")))
-        + sorted(glob.glob(os.path.join(args.dir, "FABRIC_r*.json"))))
+        + sorted(glob.glob(os.path.join(args.dir, "FABRIC_r*.json")))
+        + sorted(glob.glob(os.path.join(args.dir, "FLYWHEEL_r*.json"))))
     if not paths:
         print("perf_gate: no BENCH_*.json / SLO_*.json files found",
               file=sys.stderr)

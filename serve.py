@@ -162,6 +162,24 @@ def parse_args():
     parser.add_argument("--probe-interval-s", type=float, default=1.0,
                         dest="probe_interval_s",
                         help="fabric membership probe period")
+    # -- data flywheel request capture (ISSUE 13) — OFF by default: the
+    # engine keeps its NULL capture sink (zero hot-path work) unless a
+    # capture dir is configured
+    parser.add_argument("--capture-dir", default="", dest="capture_dir",
+                        help="spill sampled request captures (staged "
+                             "pixels + detections + score stats, PII-free)"
+                             " as atomic JSONL+npz shards here for the "
+                             "flywheel miner (off when unset)")
+    parser.add_argument("--capture-sample", type=int, default=1,
+                        dest="capture_sample",
+                        help="capture every Nth served request")
+    parser.add_argument("--capture-bytes", type=int, default=256 << 20,
+                        dest="capture_bytes",
+                        help="capture-dir byte budget: oldest shard pairs "
+                             "rotate out beyond this")
+    parser.add_argument("--capture-shard-records", type=int, default=32,
+                        dest="capture_shard_records",
+                        help="records per spilled shard pair")
     return parser.parse_args()
 
 
@@ -212,7 +230,16 @@ def _build_engine(args, cfg):
         # the common --loader-workers flag doubles as the serving prep
         # pool size (same data/workers.py pool, image-only tasks)
         prep_workers=args.loader_workers or 0,
-        serve_e2e=getattr(args, "serve_e2e", False))).start()
+        serve_e2e=getattr(args, "serve_e2e", False)))
+    if getattr(args, "capture_dir", ""):
+        from mx_rcnn_tpu.flywheel import CaptureOptions, RequestCapture
+
+        engine.capture = RequestCapture(CaptureOptions(
+            capture_dir=args.capture_dir,
+            sample_every=args.capture_sample,
+            shard_records=args.capture_shard_records,
+            byte_budget=args.capture_bytes))
+    engine.start()
     return predictor, engine
 
 

@@ -30,7 +30,15 @@ Fabric-side network chaos (ISSUE 12) follows the same split:
 ``MXR_FAULT_NET_{DROP,DELAY_MS,RESET}`` are parsed by ``NetFaults`` in
 ``mx_rcnn_tpu/serve/replica.py`` and injected member-side at the HTTP
 frontend; :func:`net_fault_env` is the composer for
-tests/test_fabric.py and script/fabric_smoke.sh."""
+tests/test_fabric.py and script/fabric_smoke.sh.
+
+Flywheel capture chaos (ISSUE 13), same split again:
+``MXR_FAULT_FLYWHEEL_{CORRUPT_SHARD,TRUNCATE_SPILL}`` (value = the
+0-based index of the spilled shard to damage) are parsed by
+``RequestCapture`` in ``mx_rcnn_tpu/flywheel/capture.py``;
+:func:`flywheel_fault_env` is the composer for tests/test_flywheel.py
+and script/flywheel_smoke.sh.  The damaged shard's replay records then
+exercise the loader's PR-2 bad-record substitution path."""
 
 from __future__ import annotations
 
@@ -173,6 +181,25 @@ def net_fault_env(index: int, drop_after=None, delay_ms=None,
         spec = (f"{int(reset_from)}" if reset_to is None
                 else f"{int(reset_from)}-{int(reset_to)}")
         env[ENV_NET_RESET] = f"{index}:{spec}"
+    return env
+
+
+def flywheel_fault_env(corrupt_shard=None, truncate_spill=None) -> dict:
+    """Compose the ``MXR_FAULT_FLYWHEEL_*`` env dict damaging a capture
+    shard after its atomic spill (simulated torn disk):
+
+    * ``corrupt_shard=N`` — shard index N's npz is overwritten with
+      garbage bytes (np.load raises on every record).
+    * ``truncate_spill=N`` — shard index N's npz is truncated to half
+      its size (the torn-write shape)."""
+    from mx_rcnn_tpu.flywheel.capture import (ENV_CORRUPT_SHARD,
+                                              ENV_TRUNCATE_SPILL)
+
+    env = {}
+    if corrupt_shard is not None:
+        env[ENV_CORRUPT_SHARD] = str(int(corrupt_shard))
+    if truncate_spill is not None:
+        env[ENV_TRUNCATE_SPILL] = str(int(truncate_spill))
     return env
 
 

@@ -1,0 +1,484 @@
+"""Data flywheel (ISSUE 13): capture → mine → replay → hot reload.
+
+Four layers, mirroring the subsystem split:
+
+* **Capture** — sampling stride exactness, atomic shard pairs, ring
+  bound, byte-budget rotation, and the NULL-sink zero-overhead pin (a
+  capture-off engine that ever reaches the sink RAISES).
+* **Mine** — hardness ranking, top-K manifest with provenance, digest
+  idempotence, SIGTERM-mid-mine atomicity (only a ``.tmp`` left behind).
+* **Replay** — ReplayDataset coordinate/threshold contract, loader
+  mixing that is bit-reproducible at a seed including mid-epoch
+  ``--auto-resume``, and chaos: a corrupt/truncated shard lands in the
+  PR-2 bad-record substitution path (counted, bounded by the systemic
+  limit).
+* **Closed loop** — serve traffic through a real engine with capture on,
+  mine it, train one replay-mixed epoch to a checkpoint, and hot-reload
+  a serving engine off that checkpoint with a strictly increasing
+  generation — the whole loop on CPU, no accelerator.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.data import AnchorLoader, SyntheticDataset
+from mx_rcnn_tpu.data.replay import ReplayDataset, load_replay_pixels
+from mx_rcnn_tpu.flywheel import (NULL_CAPTURE, CaptureOptions, FlywheelLoop,
+                                  RequestCapture, load_manifest, mine_shards,
+                                  write_manifest)
+from mx_rcnn_tpu.flywheel.capture import list_shards, score_stats
+from mx_rcnn_tpu.flywheel.miner import ENV_MINE_PAUSE_S, hardness
+from mx_rcnn_tpu.models import build_model, init_params
+from mx_rcnn_tpu.serve import ServeEngine, ServeOptions
+from mx_rcnn_tpu.serve import replica as rp
+from mx_rcnn_tpu.telemetry.report import (FLYWHEEL_COUNTERS, aggregate,
+                                          load_events, render_table)
+from mx_rcnn_tpu.train import fit
+from tests.faults import flywheel_fault_env
+from tests.replica_worker import FakeServePredictor
+from tests.test_loader_workers import (assert_batches_equal, snapshot,
+                                       tiny_cfg as loader_cfg,
+                                       tiny_roidb)
+from tests.test_serve import make_engine, raw_image
+from tests.test_serve import tiny_cfg as serve_cfg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def synth_dets(rng, n, lo=0.1, hi=0.9):
+    """n score-sorted detection records in ORIGINAL image coords."""
+    scores = np.sort(rng.uniform(lo, hi, n))[::-1]
+    return [{"cls": 1, "score": float(s),
+             "bbox": [4.0, 6.0, 60.0, 50.0]} for s in scores]
+
+
+def fill_capture(tmp_path, n=10, shard_records=4, sample_every=1,
+                 env=None, **opts):
+    """A capture dir with n submitted records, spilled and closed."""
+    d = str(tmp_path / "capture")
+    cap = RequestCapture(CaptureOptions(
+        capture_dir=d, sample_every=sample_every,
+        shard_records=shard_records, **opts), env=env)
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        px = rng.randint(0, 255, (64, 96, 3), dtype=np.uint8)
+        cap.record_batch(
+            [(px, (60, 90), (120, 180), synth_dets(rng, 4))], generation=3)
+    cap.close()
+    return d, cap
+
+
+# -- capture ---------------------------------------------------------------
+
+
+def test_null_capture_raises_and_capture_off_engine_never_records():
+    """The zero-overhead pin: the NULL sink raises on record, and a
+    capture-off engine serves a full batch without ever reaching it —
+    surviving the round trip IS the proof the hot path did no capture
+    work."""
+    with pytest.raises(RuntimeError, match="disabled"):
+        NULL_CAPTURE.record_batch([], 0)
+    engine = make_engine(serve_cfg()).start()
+    try:
+        assert engine.capture is NULL_CAPTURE
+        dets = engine.submit(raw_image(60, 100, 40)).result(timeout=30.0)
+        assert dets
+        assert "flywheel" not in engine.metrics()
+    finally:
+        engine.stop()
+
+
+def test_capture_sampling_stride_and_shard_pairs(tmp_path):
+    """sample_every=3 over 10 submits captures exactly ceil(10/3)=4
+    records (counter stride, not probabilistic) and spills complete
+    npz+jsonl pairs whose rows name their pixel keys."""
+    d, cap = fill_capture(tmp_path, n=10, shard_records=2, sample_every=3)
+    m = cap.metrics()
+    assert m["captured"] == 4 and m["sampled_out"] == 6
+    assert m["sample_every"] == 3 and m["dropped"] == 0
+    shards = list_shards(d)
+    assert len(shards) == 2 and m["shards"] == 2
+    rows = []
+    for sh in shards:
+        with open(sh["jsonl"]) as fh:
+            rows.extend(json.loads(line) for line in fh)
+        with np.load(sh["npz"]) as npz:
+            for row in rows[-1:]:
+                px = npz[row["key"]]
+                assert px.dtype == np.uint8 and px.shape == (64, 96, 3)
+    assert [r["rid"] for r in rows] == [0, 1, 2, 3]
+    for r in rows:
+        assert r["raw_hw"] == [60, 90] and r["orig_hw"] == [120, 180]
+        assert r["generation"] == 3
+        assert r["stats"]["count"] == 4
+        assert len(r["detections"]) == 4
+
+
+def test_capture_byte_budget_rotates_oldest(tmp_path):
+    """A tiny byte budget keeps only the newest shard pairs; rotation
+    never deletes the shard just written."""
+    one_shard = fill_capture(tmp_path / "probe", n=4, shard_records=4)[1]
+    nbytes = one_shard.metrics()["spilled_bytes"]
+    d, cap = fill_capture(tmp_path, n=16, shard_records=4,
+                          byte_budget=2 * nbytes)
+    shards = list_shards(d)
+    assert 1 <= len(shards) <= 2          # 4 spilled, oldest rotated out
+    assert cap.metrics()["shards"] == 4
+    # the newest shard survived and still parses
+    with open(shards[-1]["jsonl"]) as fh:
+        assert [json.loads(ln)["rid"] for ln in fh] == [12, 13, 14, 15]
+
+
+def test_score_stats_and_hardness_signals():
+    flat = score_stats([{"score": 0.5}, {"score": 0.5}, {"score": 0.5}])
+    peaked = score_stats([{"score": 0.9}, {"score": 0.01}, {"score": 0.01}])
+    assert flat["entropy"] == pytest.approx(1.0)       # maximally confused
+    assert peaked["entropy"] < flat["entropy"]
+    assert flat["bands"]["0.3"] == 3 and flat["bands"]["0.7"] == 0
+    h_flat, sig = hardness(flat)
+    h_peak, _ = hardness(peaked)
+    assert h_flat > h_peak                              # flat scores = hard
+    assert sig["disagreement"] == pytest.approx(1.0)    # all die at 0.7
+    assert score_stats([]) == {"count": 0, "max_score": 0.0,
+                               "mean_score": 0.0, "entropy": 0.0,
+                               "bands": {"0.3": 0, "0.5": 0, "0.7": 0}}
+
+
+# -- mine ------------------------------------------------------------------
+
+
+def test_mine_ranks_topk_with_provenance_and_idempotent_digest(tmp_path):
+    d, _ = fill_capture(tmp_path, n=10, shard_records=4)
+    entries, scanned, skipped = mine_shards(d, top_k=5, min_label_score=0.3)
+    assert scanned == 10 and len(entries) == 5
+    scores = [e["hardness"] for e in entries]
+    assert scores == sorted(scores, reverse=True)       # hardest first
+    for e in entries:
+        assert e["shard"].endswith(".jsonl") and e["key"].startswith("r")
+        assert e["generation"] == 3
+        assert set(e["signals"]) == {"entropy", "disagreement", "low_max"}
+    p1 = write_manifest(d, entries, scanned, 5, min_label_score=0.3)
+    p2 = write_manifest(d, entries, scanned, 5, min_label_score=0.3)
+    assert p1 == p2 and os.path.basename(p1).startswith("mined-")
+    doc = load_manifest(p1)
+    assert doc["schema"] == "mxr_mined_manifest"
+    assert doc["total_scanned"] == 10 and len(doc["entries"]) == 5
+
+
+def test_mine_skips_unlabeled_and_torn_rows(tmp_path, monkeypatch):
+    d, _ = fill_capture(tmp_path, n=4, shard_records=4)
+    # append a torn row + an unlabeled (all-low-score) row to the shard
+    sh = list_shards(d)[0]
+    with open(sh["jsonl"]) as fh:
+        template = json.loads(fh.readline())
+    unlabeled = dict(template, rid=99, key="r00000099",
+                     detections=[{"cls": 1, "score": 0.05,
+                                  "bbox": [0, 0, 10, 10]}])
+    with open(sh["jsonl"], "a") as fh:
+        fh.write(json.dumps(unlabeled) + "\n")
+        fh.write("{torn json row\n")
+    telemetry.configure(str(tmp_path / "tel"), rank=0, world=1)
+    try:
+        entries, scanned, skipped = mine_shards(d, top_k=10,
+                                                min_label_score=0.3)
+    finally:
+        telemetry.shutdown()
+    assert scanned == 6 and skipped == 2 and len(entries) == 4
+    counters = aggregate(load_events([str(tmp_path / "tel")]))["counters"]
+    assert counters["flywheel/skipped_unlabeled"] == 1
+    assert counters["flywheel/skipped_bad_row"] == 1
+    assert counters["flywheel/mined"] == 4
+
+
+def test_sigterm_mid_mine_leaves_no_partial_manifest(tmp_path):
+    """The manifest rename is the commit point: SIGTERM between tmp write
+    and rename leaves only ``*.tmp`` behind, never a readable
+    ``mined-*.json`` (driven through the real driver subprocess)."""
+    d, _ = fill_capture(tmp_path, n=4, shard_records=4)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env[ENV_MINE_PAUSE_S] = "60"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "flywheel.py"), "mine",
+         "--capture-dir", d, "--top-k", "4"], env=env, cwd=REPO)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:       # wait for the tmp to appear
+            if any(n.endswith(".tmp") for n in os.listdir(d)):
+                break
+            if proc.poll() is not None:
+                pytest.fail("miner exited before writing the tmp manifest")
+            time.sleep(0.05)
+        else:
+            pytest.fail("tmp manifest never appeared")
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) != 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    names = os.listdir(d)
+    assert not [n for n in names if n.startswith("mined-")
+                and n.endswith(".json")]
+    assert [n for n in names if n.endswith(".tmp")]
+
+
+def test_flywheel_loop_round_and_driver_json(tmp_path):
+    d, _ = fill_capture(tmp_path, n=8, shard_records=4)
+    res = FlywheelLoop(d, top_k=4).run_round(0)
+    assert res["mined"] == 4 and res["scanned"] == 8
+    assert res["manifest"] and os.path.exists(res["manifest"])
+    assert res["train_rc"] is None
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "flywheel.py"), "mine",
+         "--capture-dir", d, "--top-k", "4"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["cmd"] == "mine" and doc["mined"] == 4
+    assert doc["manifest"] and doc["train_rc"] is None
+
+
+# -- replay ----------------------------------------------------------------
+
+
+def replay_roidb_from(tmp_path, n=10, min_score=0.1, env=None):
+    d, _ = fill_capture(tmp_path, n=n, shard_records=4, env=env)
+    entries, scanned, _ = mine_shards(d, top_k=n, min_label_score=0.1)
+    path = write_manifest(d, entries, scanned, n)
+    ds = ReplayDataset(path, num_classes=5, min_score=min_score)
+    return ds.gt_roidb()
+
+
+def test_replay_dataset_scales_clips_and_filters(tmp_path):
+    roidb = replay_roidb_from(tmp_path, n=6, min_score=0.5)
+    assert roidb
+    for rec in roidb:
+        # captured raw extent 60x90, original 120x180 → boxes halved
+        assert rec["height"] == 60 and rec["width"] == 90
+        np.testing.assert_allclose(rec["boxes"][0], [2.0, 3.0, 30.0, 25.0])
+        assert (rec["gt_classes"] > 0).all()
+        assert rec["flipped"] is False
+        assert rec["image"].startswith("replay://")
+        px = load_replay_pixels(rec)
+        assert px.shape == (60, 90, 3) and px.dtype == np.uint8
+    # every pseudo-label respects the threshold: a min_score above every
+    # synthetic det drops all entries
+    assert replay_roidb_from(tmp_path / "hi", n=6, min_score=0.95) == []
+
+
+def test_replay_mix_deterministic_across_loaders(tmp_path):
+    """Two loaders at the same seed + ratio produce bit-identical batch
+    streams across two epochs, and the mix actually replays records."""
+    replay = replay_roidb_from(tmp_path, n=10)
+    roidb = tiny_roidb()
+    mk = lambda: AnchorLoader(roidb, loader_cfg(0), batch_size=2,
+                              shuffle=True, seed=3, replay_roidb=replay,
+                              replay_ratio=0.5)
+    a, b = mk(), mk()
+    assert_batches_equal(snapshot(a, epochs=2), snapshot(b, epochs=2))
+    assert a.replay_substituted == b.replay_substituted > 0
+    # the schedule length never changes: replay substitutes slots, it
+    # does not extend the epoch
+    assert a.steps_per_epoch == AnchorLoader(
+        roidb, loader_cfg(0), batch_size=2, shuffle=True,
+        seed=3).steps_per_epoch
+
+
+def test_replay_mix_mid_epoch_resume_equality(tmp_path):
+    """The --auto-resume pin across a replay-mixed epoch: fast-forward
+    (advance_epochs + skip_next) reproduces the uninterrupted tail batch
+    for batch, replay substitutions included."""
+    replay = replay_roidb_from(tmp_path, n=10)
+    roidb = tiny_roidb()
+    mk = lambda: AnchorLoader(roidb, loader_cfg(0), batch_size=2,
+                              shuffle=True, seed=11, replay_roidb=replay,
+                              replay_ratio=0.5)
+    serial = snapshot(mk(), epochs=2)
+    steps = len(serial) // 2
+    ld = mk()
+    ld.advance_epochs(1)                  # resume inside epoch 1 (0-based)
+    ld.skip_next(2)
+    assert_batches_equal(serial[steps + 2:], snapshot(ld))
+
+
+def test_corrupt_replay_shard_hits_bad_record_substitution(tmp_path):
+    """Chaos: a shard corrupted post-spill (env-injected torn disk) makes
+    its replay records unloadable; the loader substitutes them via PR-2,
+    counts loader/bad_record, and the epoch completes full-length."""
+    env = flywheel_fault_env(corrupt_shard=0)
+    assert env == {"MXR_FAULT_FLYWHEEL_CORRUPT_SHARD": "0"}
+    replay = replay_roidb_from(tmp_path, n=4, env=env)
+    assert replay                         # jsonl intact: records mined
+    with pytest.raises(Exception):
+        load_replay_pixels(replay[0])     # npz garbage: load raises
+    roidb = tiny_roidb()
+    telemetry.configure(str(tmp_path / "tel"), rank=0, world=1)
+    try:
+        ld = AnchorLoader(roidb, loader_cfg(0), batch_size=2, shuffle=True,
+                          seed=3, replay_roidb=replay, replay_ratio=0.5)
+        batches = snapshot(ld)
+    finally:
+        telemetry.shutdown()
+    assert len(batches) == ld.steps_per_epoch
+    for b in batches:
+        assert np.isfinite(b["images"]).all()
+    counters = aggregate(load_events([str(tmp_path / "tel")]))["counters"]
+    assert counters["loader/bad_record"] >= 1
+    assert counters["flywheel/replayed"] == ld.replay_substituted > 0
+
+
+def test_truncated_spill_is_systemic_when_everything_is_corrupt(tmp_path):
+    """The PR-2 bound: a loader whose records ALL point at one truncated
+    shard cannot substitute its way out — it raises the systemic error
+    instead of looping forever."""
+    replay = replay_roidb_from(tmp_path, n=4,
+                               env=flywheel_fault_env(truncate_spill=0))
+    assert replay
+    ld = AnchorLoader(replay, loader_cfg(0), batch_size=2, shuffle=False,
+                      seed=0)
+    with pytest.raises(RuntimeError, match="systemic"):
+        list(ld)
+
+
+def test_flywheel_counters_render_as_report_table(tmp_path):
+    telemetry.configure(str(tmp_path), rank=0, world=1)
+    try:
+        tel = telemetry.get()
+        tel.counter("flywheel/captured", 8)
+        tel.counter("flywheel/mined", 4)
+        tel.counter("flywheel/replayed", 2)
+    finally:
+        telemetry.shutdown()
+    summary = aggregate(load_events([str(tmp_path)]))
+    table = render_table(summary)
+    assert "flywheel" in table and "flywheel/mined" in table
+    for name in ("flywheel/captured", "flywheel/mined", "flywheel/replayed"):
+        assert name in FLYWHEEL_COUNTERS
+
+
+# -- loadgen capture check + perf gate rows --------------------------------
+
+
+def test_loadgen_capture_check_failure_logic():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from loadgen import capture_check_failure
+    finally:
+        sys.path.pop(0)
+    # exact match, strided sampling, and within-tolerance all pass
+    assert capture_check_failure({"captured": 0}, {"captured": 10,
+                                 "sample_every": 1}, 10, 0.1) is None
+    assert capture_check_failure({"captured": 5}, {"captured": 9,
+                                 "sample_every": 3}, 12, 0.1) is None
+    # silent capture loss fails loudly
+    msg = capture_check_failure({"captured": 0}, {"captured": 2,
+                                "sample_every": 1}, 10, 0.1)
+    assert msg and "captured delta 2" in msg
+    # a capture-off target is itself a smoke-script bug
+    assert "no flywheel section" in capture_check_failure({}, {}, 10, 0.1)
+
+
+def test_perf_gate_flywheel_floor_rows(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import perf_gate as pg
+    finally:
+        sys.path.pop(0)
+    doc = {"schema": "mxr_flywheel_report", "captured": 40, "mined": 8,
+           "generation_before": 0, "generation_after": 1}
+    rows = {r["metric"]: r for r in pg.flywheel_report_rows(doc)}
+    assert rows["flywheel_mined_fraction"]["value"] == pytest.approx(0.2)
+    assert rows["flywheel_mined_fraction"]["floor"] == 0.01
+    assert rows["flywheel_reload_generations"]["value"] == 1.0
+    assert rows["flywheel_reload_generations"]["floor"] == 1.0
+    path = tmp_path / "FLYWHEEL_r01.json"
+    path.write_text(json.dumps(doc))
+    assert {r["metric"] for r in pg.load_rows(str(path))} == set(rows)
+    # a stalled loop (no generation advance) sits under the floor
+    stalled = pg.flywheel_report_rows(dict(doc, generation_after=0))
+    gen = [r for r in stalled if r["metric"] == "flywheel_reload_generations"]
+    assert gen[0]["value"] < gen[0]["floor"]
+
+
+# -- closed loop -----------------------------------------------------------
+
+
+def test_closed_loop_serve_capture_mine_train_reload(tmp_path):
+    """The acceptance pin, end to end on CPU: serve traffic → captured
+    shards → mined manifest → ReplayDataset mixed into one training
+    epoch → checkpoint → CheckpointWatcher-driven hot reload on a live
+    engine with a strictly increasing generation."""
+    scfg = serve_cfg()
+    cap_dir = str(tmp_path / "capture")
+    pred = FakeServePredictor(scfg, {"scale": np.float32(1.0)})
+    engine = ServeEngine(pred, scfg, ServeOptions(
+        batch_size=4, max_delay_ms=1.0, max_queue=32))
+    engine.capture = RequestCapture(CaptureOptions(
+        capture_dir=cap_dir, sample_every=1, shard_records=4))
+    engine.start()
+    try:
+        futs = [engine.submit(raw_image(60 + i, 100 + i, 30 + 5 * i))
+                for i in range(8)]
+        for f in futs:
+            assert f.result(timeout=30.0)
+        m = engine.metrics()
+        assert m["flywheel"]["captured"] == 8
+    finally:
+        engine.stop()                       # close() spills the remainder
+
+    entries, scanned, _ = mine_shards(cap_dir, top_k=6,
+                                      min_label_score=0.1)
+    assert scanned == 8 and len(entries) == 6
+    manifest = write_manifest(cap_dir, entries, scanned, 6)
+    replay = ReplayDataset(manifest, num_classes=21,
+                           min_score=0.1).gt_roidb()
+    assert replay
+
+    tcfg = loader_cfg(0)
+    base = SyntheticDataset(num_images=4, num_classes=tcfg.NUM_CLASSES,
+                            height=64, width=96).gt_roidb()
+    loader = AnchorLoader(base, tcfg, batch_size=2, shuffle=True, seed=0,
+                          replay_roidb=replay, replay_ratio=0.5)
+    model = build_model(tcfg)
+    params = init_params(model, tcfg, jax.random.PRNGKey(0), 1, (64, 96))
+    prefix = str(tmp_path / "ckpt")
+    fit(tcfg, model, params, loader, begin_epoch=0, end_epoch=1,
+        prefix=prefix, frequent=100)
+    assert loader.replay_substituted > 0    # the epoch actually mixed
+
+    target = rp.scan_checkpoints(prefix)
+    assert target and target["epoch"] == 1
+
+    pred2 = FakeServePredictor(scfg, {"scale": np.float32(1.0)})
+    engine2 = ServeEngine(pred2, scfg, ServeOptions(
+        batch_size=2, max_delay_ms=1.0, max_queue=8)).start()
+    try:
+        gen_before = engine2.generation
+        reloads = []
+
+        def reload_fn(t):
+            ok, info = rp.reload_engine_params(
+                engine2, pred2, scfg, dict(t, prefix=prefix),
+                load_params_fn=lambda _t, _c: {"scale": np.float32(2.0)})
+            reloads.append(info)
+            return ok
+
+        watcher = rp.CheckpointWatcher(prefix, reload_fn)
+        got = watcher.poll_once()           # sees the replay-trained save
+        assert got is not None and got[1]
+        assert engine2.generation > gen_before
+        assert watcher.poll_once() is None  # dedup: no flapping
+        dets = engine2.submit(raw_image(60, 100, 40)).result(timeout=30.0)
+        assert dets                         # new generation serves
+    finally:
+        engine2.stop()

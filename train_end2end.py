@@ -29,8 +29,8 @@ from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.tools.common import (CappedLoader, add_common_args,
                                       check_dist_loader, config_from_args,
                                       get_imdb, get_train_roidb,
-                                      init_or_load_params, setup_parallel,
-                                      start_observability,
+                                      init_or_load_params, replay_from_args,
+                                      setup_parallel, start_observability,
                                       strip_device_prep_for_mesh)
 from mx_rcnn_tpu.train import ResilienceOptions, fit
 
@@ -61,9 +61,15 @@ def train_net(args):
 
     imdb = get_imdb(args, cfg)
     roidb = get_train_roidb(imdb, cfg)
+    # data flywheel (--replay-manifest): mix mined serving captures into
+    # the epoch plan; the mix is drawn from the loader's plan RNG, so it
+    # replays bit-identically under --auto-resume
+    replay_roidb, replay_ratio = replay_from_args(args, cfg)
     loader = AnchorLoader(roidb, cfg, batch_size,
                           shuffle=cfg.TRAIN.SHUFFLE,
-                          num_parts=pcount, part_index=pidx)
+                          num_parts=pcount, part_index=pidx,
+                          replay_roidb=replay_roidb,
+                          replay_ratio=replay_ratio)
     check_dist_loader(plan, batch_size, pcount, pidx)
     if args.num_steps:
         loader = CappedLoader(loader, args.num_steps)
