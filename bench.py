@@ -517,7 +517,7 @@ def bench_infer_loader(batch: int, network: str = "resnet101"):
 
 
 def bench_serve(batch: int, network: str = "resnet101",
-                serve_e2e: bool = False):
+                serve_e2e: bool = False, stream: bool = False):
     """Steady-state imgs/sec through the REAL serving engine — the number
     capacity planning needs (how many replicas for X qps), distinct from
     ``--mode infer``'s forward-only rate by exactly the serving tax:
@@ -579,6 +579,7 @@ def bench_serve(batch: int, network: str = "resnet101",
 
     feeders = 4
     best = None
+    stream_dpf = stream_skip = None
     try:
         for _ in range(4):
             futs = [None] * wave
@@ -597,6 +598,51 @@ def bench_serve(batch: int, network: str = "resnet101",
             for f in futs:
                 f.result(timeout=600.0)
             best = max(best or 0.0, wave / (time.time() - t0))
+        if stream:
+            # streaming phase (--serve-stream): 4 static-motion streams
+            # through a StreamManager with the skip gate on — the
+            # coalescing/skip wins as counter ratios (dispatches_per_frame,
+            # skip_fraction), which perf_gate scores as their OWN series,
+            # never against the request/response throughput above
+            from mx_rcnn_tpu.serve import StreamManager, StreamOptions
+
+            mgr = StreamManager(engine, StreamOptions(skip_thresh=3.0,
+                                                      max_skip=16))
+            mgr.warmup()
+            n_streams, frames = 4, 32
+            rngs = [np.random.RandomState(100 + s)
+                    for s in range(n_streams)]
+            bases = []
+            for s in range(n_streams):
+                h, w = (short, long_) if s % 2 == 0 else (long_, short)
+                bases.append(rngs[s].randint(0, 255, (h, w, 3),
+                                             dtype=np.uint8))
+            d0 = engine.counters["dispatches"]
+
+            def run_stream(s):
+                for i in range(frames):
+                    f = bases[s].copy()
+                    # static profile: a handful of ±1 sensor-noise pixels
+                    ys = rngs[s].randint(0, f.shape[0], 8)
+                    xs = rngs[s].randint(0, f.shape[1], 8)
+                    f[ys, xs] = np.clip(
+                        f[ys, xs].astype(np.int16) + 1, 0,
+                        255).astype(np.uint8)
+                    mgr.submit_frame(f"bench-{s}", i + 1,
+                                     f).result(timeout=600.0)
+
+            sts = [threading.Thread(target=run_stream, args=(s,))
+                   for s in range(n_streams)]
+            for th in sts:
+                th.start()
+            for th in sts:
+                th.join()
+            total = n_streams * frames
+            stream_dpf = round(
+                (engine.counters["dispatches"] - d0) / total, 4)
+            stream_skip = round(
+                mgr.counters["skipped"] / max(mgr.counters["frames"], 1),
+                4)
     finally:
         # latency from the engine's own request-time histogram (submit →
         # response, over every timed wave) so the BENCH row carries p50/
@@ -617,7 +663,8 @@ def bench_serve(batch: int, network: str = "resnet101",
             (None if p50 is None else round(p50 * 1e3, 3)),
             (None if p99 is None else round(p99 * 1e3, 3)),
             round(cold_start_s, 3), round(warmup_compile_s, 3),
-            round(readback_per_img, 1), round(host_prep_ms, 3))
+            round(readback_per_img, 1), round(host_prep_ms, 3),
+            stream_dpf, stream_skip)
 
 
 def bench_infer_mask(batch: int, network: str = "resnet101_fpn_mask"):
@@ -721,6 +768,13 @@ def main():
                          "in, (B, cap, 6) detections out).  The metric is "
                          "suffixed _e2e — its own baseline series, never "
                          "compared against the unfused engine rows")
+    ap.add_argument("--serve-stream", action="store_true",
+                    dest="serve_stream",
+                    help="serve mode: also run a streaming phase (4 "
+                         "static-motion streams through a StreamManager "
+                         "with the frame-delta gate on) and report "
+                         "dispatches_per_frame + skip_fraction as their "
+                         "own gated series")
     ap.add_argument("--pipeline-images", type=int, default=32,
                     dest="pipeline_images",
                     help="pipeline mode: synthetic roidb size per epoch")
@@ -859,8 +913,10 @@ def main():
         metric = "infer_imgs_per_sec_mask_eval"
     elif args.mode == "serve":
         (value, serve_p50_ms, serve_p99_ms, serve_cold_start_s,
-         serve_warmup_s, serve_readback_b, serve_prep_ms) = bench_serve(
-             args.batch, args.network, serve_e2e=args.serve_e2e)
+         serve_warmup_s, serve_readback_b, serve_prep_ms,
+         serve_stream_dpf, serve_stream_skip) = bench_serve(
+             args.batch, args.network, serve_e2e=args.serve_e2e,
+             stream=args.serve_stream)
         metric = ("serve_imgs_per_sec_e2e" if args.serve_e2e
                   else "serve_imgs_per_sec")
         infer_method = "engine"  # not comparable to forward-only rows
@@ -998,6 +1054,14 @@ def main():
         # regress, and host_prep_ms pins the submit-thread prep tax
         out["readback_bytes_per_image"] = serve_readback_b
         out["host_prep_ms"] = serve_prep_ms
+        # streaming phase (--serve-stream only): perf_gate expands these
+        # into a direction=down dispatches_per_frame series and a
+        # skip_fraction FLOOR row — their own families, never scored
+        # against the request/response rows (the BENCH_r08 precedent)
+        if serve_stream_dpf is not None:
+            out["dispatches_per_frame"] = serve_stream_dpf
+        if serve_stream_skip is not None:
+            out["skip_fraction"] = serve_stream_skip
     if opt_acc is not None:
         out["opt_acc"] = opt_acc
     if eval_rates is not None:

@@ -20,6 +20,11 @@ jitted bucket programs, and the shared ``ops/postprocess`` block that
 * ``supervisor`` — the parent-side: liveness/readiness probing, crash/
   hang detection, backoff respawn with a systemic limit, rolling
   reloads, and the retry-budgeted request router.
+* ``stream``     — sequenced-frame streaming over the same batcher:
+  per-stream state (reference frame + cached detections), cross-stream
+  temporal coalescing (same-bucket frames from different streams share
+  one ``serve_e2e`` dispatch), and an on-device ``frame_delta`` skip
+  gate that answers low-motion frames from cache without any forward.
 * ``fabric``     — the cross-host generalization: a transport-agnostic
   replica pool (local fork children + remote TCP members that ``--join``
   or are registered by address), HTTP-probe-driven membership with
@@ -44,6 +49,7 @@ from mx_rcnn_tpu.serve.fabric import (CircuitBreaker, FabricOptions,
 from mx_rcnn_tpu.serve.frontend import (address_request, address_request_raw,
                                         encode_image_payload, make_server,
                                         parse_address, run_stdio,
+                                        run_stream_stdio,
                                         tcp_http_request, tcp_http_request_raw,
                                         unix_http_request,
                                         unix_http_request_raw)
@@ -55,6 +61,8 @@ from mx_rcnn_tpu.serve.supervisor import (ReplicaRouter, ReplicaSpec,
                                           ReplicaSupervisor,
                                           SupervisorOptions,
                                           make_router_server, replica_specs)
+from mx_rcnn_tpu.serve.stream import (FrameResult, StaleSeqError,
+                                      StreamManager, StreamOptions)
 from mx_rcnn_tpu.serve.warmup import warmup
 
 __all__ = ["ServeEngine", "ServeOptions", "ServeFuture", "RejectedError",
@@ -69,4 +77,6 @@ __all__ = ["ServeEngine", "ServeOptions", "ServeFuture", "RejectedError",
            "RemoteMember", "ReplicaPool", "make_fabric_server",
            "normalize_address", "register_with_router", "NetFaults",
            "parse_address", "address_request", "address_request_raw",
-           "tcp_http_request", "tcp_http_request_raw"]
+           "tcp_http_request", "tcp_http_request_raw",
+           "StreamManager", "StreamOptions", "StaleSeqError",
+           "FrameResult", "run_stream_stdio"]

@@ -163,11 +163,11 @@ class ServeFuture:
 class _Request:
     __slots__ = ("image", "im_info", "t_enqueue", "deadline", "bucket",
                  "future", "raw_hw", "ratio", "orig_hw", "staged",
-                 "staged_hw")
+                 "staged_hw", "stream")
 
     def __init__(self, image, im_info, t_enqueue, deadline, bucket=None,
                  raw_hw=None, ratio=None, orig_hw=None, staged=None,
-                 staged_hw=None):
+                 staged_hw=None, stream=None):
         self.image = image          # bucket-padded network input, or (in
         # serve_e2e mode) the STAGED raw uint8 bucket array
         self.im_info = im_info
@@ -185,6 +185,8 @@ class _Request:
         self.orig_hw = orig_hw
         self.staged = staged
         self.staged_hw = staged_hw
+        self.stream = stream        # stream_id when submitted via a
+        # StreamManager; lets the flush side count cross-stream coalescing
         self.future = ServeFuture()
 
 
@@ -236,7 +238,15 @@ class ServeEngine:
                          # path reports its own so bench can compare)
                          "h2d_transfers": 0, "dispatches": 0,
                          "readbacks": 0, "readback_bytes": 0,
-                         "host_prep_ms_total": 0.0}
+                         "host_prep_ms_total": 0.0,
+                         # stream-aware flush bookkeeping: batches that
+                         # carried >= 1 stream frame, the frame count, and
+                         # batches mixing frames from DIFFERENT streams
+                         # (the cross-stream coalescing win).  Skipped
+                         # frames never reach the engine, so the 1/1/1
+                         # per-batch contract above is stream-agnostic.
+                         "stream_batches": 0, "stream_batch_frames": 0,
+                         "stream_coalesced_batches": 0}
         self._pool = None  # prep worker pool (opts.prep_workers > 0)
         # engine-authoritative latency distributions (same contract as
         # self.counters: live even with telemetry off — the controller's
@@ -264,6 +274,10 @@ class ServeEngine:
         # check per batch, and the NULL sink raises if recorded into.
         from mx_rcnn_tpu.flywheel.capture import NULL_CAPTURE
         self.capture = NULL_CAPTURE
+        # StreamManager attaches itself here; /metrics grows a "stream"
+        # section when set.  The engine never calls into it — streaming
+        # stays a layer above the batcher.
+        self.stream = None
 
     # -- lifecycle -------------------------------------------------------
 
@@ -434,10 +448,14 @@ class ServeEngine:
             return out
 
     def submit(self, image: np.ndarray,
-               deadline_ms: Optional[float] = None) -> ServeFuture:
+               deadline_ms: Optional[float] = None,
+               stream: Optional[str] = None) -> ServeFuture:
         """Enqueue one raw RGB HWC image (uint8 or float).  Returns a
         :class:`ServeFuture`; raises :class:`RejectedError` immediately
-        when the queue is full or the engine is stopped."""
+        when the queue is full or the engine is stopped.  ``stream`` tags
+        the request with its originating stream_id (StreamManager) so the
+        flush side can account cross-stream batch sharing — it changes
+        nothing about routing, batching, or the forward."""
         if image.ndim != 3 or image.shape[2] != 3:
             raise ValueError(f"expected (H, W, 3) RGB image, "
                              f"got shape {tuple(image.shape)}")
@@ -492,7 +510,7 @@ class ServeEngine:
         deadline = now + deadline_ms / 1e3 if deadline_ms > 0 else None
         req = _Request(prepared, im_info, now, deadline, bucket=key,
                        raw_hw=raw_hw, ratio=ratio, orig_hw=orig_hw,
-                       staged=staged, staged_hw=staged_hw)
+                       staged=staged, staged_hw=staged_hw, stream=stream)
         with self._cond:
             if self._stop:
                 self.counters["rejected"] += 1
@@ -658,14 +676,26 @@ class ServeEngine:
                     h = new_bucket_hists[bk] = Hist()
                 h.observe(req_s)
                 tel.observe(f"serve/request_time/{bk}", req_s)
+        stream_ids = {r.stream for r in reqs if r.stream is not None}
+        stream_frames = sum(1 for r in reqs if r.stream is not None)
         with self._lock:
             self._bucket_hists.update(new_bucket_hists)
             self.counters["batches"] += 1
             self.counters["served"] += len(reqs)
+            if stream_frames:
+                self.counters["stream_batches"] += 1
+                self.counters["stream_batch_frames"] += stream_frames
+                if len(stream_ids) > 1:
+                    self.counters["stream_coalesced_batches"] += 1
             for k, v in xfer.items():
                 self.counters[k] = self.counters.get(k, 0) + v
         tel.counter("serve/batches")
         tel.counter("serve/images", len(reqs))
+        if stream_frames:
+            tel.counter("stream/batches")
+            tel.counter("stream/batch_frames", stream_frames)
+            if len(stream_ids) > 1:
+                tel.counter("stream/coalesced_batches")
         if self.capture.enabled:
             entries = []
             for r in reqs:
@@ -818,6 +848,8 @@ class ServeEngine:
         out["dtype"] = self._dtype
         if self.capture.enabled:
             out["flywheel"] = self.capture.metrics()
+        if self.stream is not None:
+            out["stream"] = self.stream.metrics()
         if self.registry is not None:
             out["compile"] = self.registry.snapshot()
         ctrl = self.controller

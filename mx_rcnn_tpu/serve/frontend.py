@@ -18,6 +18,18 @@ Endpoints:
   ``"deadline_ms"``.  200 → ``{"detections": [{"cls", "score", "bbox"}...],
   "queue_wait_ms"}``; 503 queue full (backpressure — retry with backoff);
   504 deadline exceeded; 400 malformed.
+* ``POST /stream`` — sequenced-frame streaming (only when the server was
+  built with a ``stream`` manager; 404 otherwise).  Body is NDJSON: one
+  frame per line, each a predict payload plus ``"stream_id"`` (str) and
+  ``"seq"`` (strictly increasing int per stream).  The connection is
+  persistent (HTTP/1.1 keep-alive) and a body may carry many frames —
+  all frames are submitted BEFORE any is waited on, so one client's
+  pipeline fills batches alongside other streams (cross-stream
+  coalescing).  Response is NDJSON in submit order, each line
+  ``{"status", "stream_id", "seq", "skipped", "detections",
+  "queue_wait_ms"}``; per-frame statuses mirror ``/predict`` (400/503/
+  504), plus 409 for a stale ``seq``.  The HTTP envelope is 200 as long
+  as the body parsed.
 * ``GET /healthz`` — liveness: 200 once the engine thread is up (a
   warming or draining replica still answers — backward-compatible).
 * ``GET /readyz`` — readiness: 200 only once warmup has registered every
@@ -54,6 +66,7 @@ import numpy as np
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.serve.engine import (DeadlineExceededError, RejectedError,
                                       ServeEngine)
+from mx_rcnn_tpu.serve.stream import StaleSeqError, StreamManager
 from mx_rcnn_tpu.telemetry.obs import PROM_CONTENT_TYPE, serve_prometheus
 
 # result-wait ceiling for one HTTP request; the engine's own per-request
@@ -117,9 +130,92 @@ def handle_request_doc(engine: ServeEngine, doc: dict) -> tuple:
     return 200, {"detections": dets, "queue_wait_ms": round(qms, 3)}
 
 
+def submit_stream_frame(stream: StreamManager, doc: dict) -> tuple:
+    """Validate + submit one stream frame WITHOUT waiting — the submit
+    half of the pipelined ``/stream`` handler.  Returns
+    ``(None, None, FrameResult)`` on acceptance or
+    ``(status, error_doc, None)`` on submit-side failure."""
+    sid, seq = doc.get("stream_id"), doc.get("seq")
+    if not isinstance(sid, str) or not sid:
+        return 400, {"error": "frame needs a non-empty string "
+                              "'stream_id'"}, None
+    if not isinstance(seq, int) or isinstance(seq, bool):
+        return 400, {"error": "frame needs an integer 'seq'",
+                     "stream_id": sid}, None
+    try:
+        img = decode_image_payload(doc)
+    except (ValueError, TypeError, KeyError) as e:
+        return 400, {"error": str(e), "stream_id": sid, "seq": seq}, None
+    try:
+        res = stream.submit_frame(sid, seq, img,
+                                  deadline_ms=doc.get("deadline_ms"))
+    except StaleSeqError as e:
+        return 409, {"error": str(e), "stream_id": sid, "seq": seq}, None
+    except RejectedError as e:
+        return 503, {"error": str(e), "stream_id": sid, "seq": seq}, None
+    except Exception as e:  # noqa: BLE001 — surface as a 500, keep serving
+        logger.exception("stream submit failed")
+        return 500, {"error": f"{type(e).__name__}: {e}",
+                     "stream_id": sid, "seq": seq}, None
+    return None, None, res
+
+
+def resolve_stream_frame(res) -> tuple:
+    """The wait half: one accepted :class:`FrameResult` →
+    ``(status, response_doc)`` with ``/predict``'s status semantics."""
+    try:
+        dets = res.result(timeout=WAIT_TIMEOUT_S)
+    except RejectedError as e:
+        return 503, {"error": str(e), "stream_id": res.stream_id,
+                     "seq": res.seq}
+    except (DeadlineExceededError, TimeoutError) as e:
+        return 504, {"error": str(e), "stream_id": res.stream_id,
+                     "seq": res.seq}
+    except Exception as e:  # noqa: BLE001
+        logger.exception("stream frame failed")
+        return 500, {"error": f"{type(e).__name__}: {e}",
+                     "stream_id": res.stream_id, "seq": res.seq}
+    out = {"stream_id": res.stream_id, "seq": res.seq,
+           "skipped": res.skipped, "detections": dets,
+           "queue_wait_ms": round((res.queue_wait_s or 0.0) * 1e3, 3)}
+    if res.delta is not None:
+        out["delta"] = round(res.delta, 4)
+    return 200, out
+
+
+def handle_stream_doc(stream: StreamManager, doc: dict) -> tuple:
+    """One frame, submit + wait → (status, response_doc).  The stdio
+    transport's unit; HTTP goes through :func:`handle_stream_lines` to
+    pipeline multi-frame bodies."""
+    status, err, res = submit_stream_frame(stream, doc)
+    if res is None:
+        return status, err
+    return resolve_stream_frame(res)
+
+
+def handle_stream_lines(stream: StreamManager, lines) -> list:
+    """NDJSON body → list of (status, doc) replies in input order.
+    Submits EVERY frame before resolving any, so a single connection's
+    burst coalesces into shared batches instead of serializing."""
+    staged = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError as e:
+            staged.append((400, {"error": f"bad JSON line: {e}"}, None))
+            continue
+        staged.append(submit_stream_frame(stream, doc))
+    return [(status, err) if res is None else resolve_stream_frame(res)
+            for status, err, res in staged]
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     engine: ServeEngine = None  # set by make_server subclassing
+    stream: Optional[StreamManager] = None  # enables POST /stream
     reloader = None      # optional callback(doc) -> (status, doc)
     request_hook = None  # optional callback(status) after each /predict
     gate = None          # optional callback() before any handling
@@ -180,8 +276,25 @@ class _Handler(BaseHTTPRequestHandler):
         if self.net_faults is not None and \
                 self.net_faults.intercept(self.path, self):
             return
-        if self.path not in ("/predict", "/admin/reload"):
+        if self.path not in ("/predict", "/admin/reload", "/stream"):
             self._reply(404, {"error": f"no route {self.path}"})
+            return
+        if self.path == "/stream":
+            if self.stream is None:
+                self._reply(404, {"error": "streaming not enabled "
+                                           "(start with --stream)"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+            except ValueError as e:
+                self._reply(400, {"error": f"bad Content-Length: {e}"})
+                return
+            replies = handle_stream_lines(
+                self.stream, body.decode("utf-8", "replace").splitlines())
+            payload = "".join(json.dumps({"status": s, **d}) + "\n"
+                              for s, d in replies)
+            self._reply_raw(200, payload.encode(), "application/x-ndjson")
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -225,7 +338,7 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
                 host: str = "127.0.0.1",
                 unix_socket: Optional[str] = None,
                 reloader=None, request_hook=None, gate=None,
-                net_faults=None):
+                net_faults=None, stream: Optional[StreamManager] = None):
     """Build (not start) the HTTP server — exactly one of ``port`` /
     ``unix_socket``.  Caller owns ``serve_forever``/``shutdown``.
 
@@ -243,6 +356,7 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
         pass
 
     Handler.engine = engine
+    Handler.stream = stream  # a StreamManager enables POST /stream
     # staticmethod: a plain function stored on the class would otherwise
     # bind as a method and receive the handler as a bogus first argument
     Handler.reloader = staticmethod(reloader) if reloader else None
@@ -402,5 +516,26 @@ def run_stdio(engine: ServeEngine, inp=None, out=None):
             status, resp = 400, {"error": f"bad JSON line: {e}"}
         else:
             status, resp = handle_request_doc(engine, doc)
+        out.write(json.dumps({"status": status, **resp}) + "\n")
+        out.flush()
+
+
+def run_stream_stdio(stream: StreamManager, inp=None, out=None):
+    """Stream twin of :func:`run_stdio`: each input line is a frame doc
+    (predict payload + ``stream_id``/``seq``), each output line
+    ``{"status": N, ...}`` — the pipe-based stream harness the contract
+    tests drive without a socket.  Returns on EOF."""
+    inp = inp if inp is not None else sys.stdin
+    out = out if out is not None else sys.stdout
+    for line in inp:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError as e:
+            status, resp = 400, {"error": f"bad JSON line: {e}"}
+        else:
+            status, resp = handle_stream_doc(stream, doc)
         out.write(json.dumps({"status": status, **resp}) + "\n")
         out.flush()

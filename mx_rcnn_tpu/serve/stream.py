@@ -1,0 +1,382 @@
+"""Streaming serving: per-stream temporal state over the shared batcher.
+
+Request/response serving treats every image as independent; a 30 fps
+camera therefore pays the full prep → forward → NMS cost 30 times a
+second even when nothing in the scene moved.  This module adds the two
+wins that workload class leaves on the table:
+
+* **Cross-stream temporal coalescing** — frames from *different* streams
+  route into the one :class:`~mx_rcnn_tpu.serve.engine.ServeEngine`
+  bucket batcher (``submit(..., stream=...)``), so same-bucket frames
+  from many cameras share one ``serve_e2e`` dispatch.  The engine's
+  flush bookkeeping counts how often that happens
+  (``stream_coalesced_batches`` / batch occupancy on ``/metrics``).
+* **Frame-delta skip** — an ON-DEVICE gate (registry kind
+  ``frame_delta``, one tiny program per bucket, AOT-warm like
+  ``device_prep``) computes the mean absolute pixel delta between the
+  incoming staged uint8 frame and the stream's *reference* frame (the
+  last frame that took the full path).  Below ``skip_thresh`` the
+  stream's cached detections answer immediately — no batch, no forward,
+  ZERO ``serve_e2e`` counter deltas (the 1/1/1 contract is untouched)
+  and no ``serve/service_time`` observation (the SLO controller never
+  sees a skip).  Above it — or on bucket change, generation change
+  (weight hot-reload), or after ``max_skip`` consecutive skips — the
+  frame takes the normal fused path and becomes the new reference.
+
+Accuracy caveat: a skipped frame returns the reference frame's
+detections verbatim.  ``skip_thresh`` is in mean-absolute uint8 units
+over the whole staged bucket (padding included — a size change reads as
+motion, which is the safe direction); 0 disables the gate entirely, and
+a gate-off stream is byte-for-byte the ``/predict`` path (pinned by
+``tests/test_stream.py``).
+
+Ordering: one stream's frames are serialized by a per-stream lock and a
+strictly-increasing ``seq`` (stale/duplicate seqs raise
+:class:`StaleSeqError` — the frontend's 409), so per-stream response
+order holds no matter how frames from other streams interleave in the
+batcher.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.data.image import stage_raw_to_bucket
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.serve.engine import RejectedError, ServeEngine
+from mx_rcnn_tpu.telemetry import Hist
+
+KIND = "frame_delta"
+
+
+class StaleSeqError(ValueError):
+    """Frame ``seq`` not strictly greater than the stream's last — the
+    frontend's 409 (a reconnecting client must resume past its high
+    -water mark, not replay)."""
+
+
+def _build_frame_delta():
+    """The gate program: mean |a - b| over two staged uint8 buffers of
+    one bucket shape, as a float32 scalar.  uint8 in, one scalar out —
+    the readback is 4 bytes."""
+    import jax
+    import jax.numpy as jnp
+
+    def delta(a, b):
+        return jnp.mean(jnp.abs(a.astype(jnp.float32)
+                                - b.astype(jnp.float32)))
+
+    return jax.jit(delta)
+
+
+@dataclass(frozen=True)
+class StreamOptions:
+    """Stream knobs (CLI: ``--stream-skip-thresh`` / ``--stream-max-skip``)."""
+
+    # mean absolute uint8 pixel delta below which a frame skips the
+    # forward and answers with the reference frame's cached detections;
+    # <= 0 disables the gate (pure coalescing, byte-identical results)
+    skip_thresh: float = 0.0
+    # forced refresh cadence: after this many CONSECUTIVE skips the next
+    # frame takes the full path regardless of its delta, bounding how
+    # stale a static scene's detections can get
+    max_skip: int = 30
+    # stream-table cap: a frame for a NEW stream beyond this is rejected
+    # (503) once no idle stream can be evicted
+    max_streams: int = 256
+    # streams idle this long are evictable when the table is full
+    idle_ttl_s: float = 300.0
+
+    def __post_init__(self):
+        if self.max_skip < 1:
+            raise ValueError(f"max_skip must be >= 1, got {self.max_skip}")
+        if self.max_streams < 1:
+            raise ValueError(
+                f"max_streams must be >= 1, got {self.max_streams}")
+
+
+class FrameResult:
+    """Completion handle for one stream frame.  ``skipped`` frames share
+    the REFERENCE frame's future (usually already resolved — the skip
+    answers without touching the engine); forwarded frames carry their
+    own live :class:`~mx_rcnn_tpu.serve.engine.ServeFuture`."""
+
+    __slots__ = ("stream_id", "seq", "skipped", "delta", "_future")
+
+    def __init__(self, stream_id, seq, skipped, delta, future):
+        self.stream_id = stream_id
+        self.seq = seq
+        self.skipped = skipped
+        self.delta = delta  # gate measurement (None when the gate is off
+        # or the frame could not be compared — first frame, bucket change)
+        self._future = future
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def result(self, timeout: Optional[float] = None):
+        """Detections records — the reference frame's when skipped."""
+        return self._future.result(timeout)
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        return None if self.skipped else self._future.queue_wait_s
+
+
+class _StreamState:
+    __slots__ = ("stream_id", "last_seq", "bucket", "ref_dev", "ref_future",
+                 "generation", "skip_run", "last_used", "lock")
+
+    def __init__(self, stream_id: str):
+        self.stream_id = stream_id
+        self.last_seq = 0
+        self.bucket = None      # (H, W) bucket of the reference frame
+        self.ref_dev = None     # reference staged uint8, ON DEVICE
+        self.ref_future = None  # the reference frame's ServeFuture
+        self.generation = -1    # engine generation the reference was served at
+        self.skip_run = 0       # consecutive skips since the last forward
+        self.last_used = time.monotonic()
+        self.lock = threading.Lock()  # serializes one stream's frames
+
+
+class StreamManager:
+    """Per-stream state over a started :class:`ServeEngine`.
+
+    Attaching (construction) sets ``engine.stream`` so ``/metrics`` grows
+    the ``stream`` section and the dispatcher's flush bookkeeping counts
+    cross-stream batch sharing.  ``registry`` defaults to the engine's
+    (a real Predictor's ProgramRegistry); without one the gate falls back
+    to a local jit — same math, no AOT markers."""
+
+    def __init__(self, engine: ServeEngine,
+                 options: Optional[StreamOptions] = None, registry=None):
+        self.engine = engine
+        self.opts = options or StreamOptions()
+        self._streams: Dict[str, _StreamState] = {}
+        self._lock = threading.Lock()  # guards _streams + counters
+        self.counters = {"frames": 0, "forwarded": 0, "skipped": 0,
+                         "delta_dispatches": 0, "refreshes": 0,
+                         "bucket_switches": 0, "stale_seq": 0, "evicted": 0}
+        # skip-response latency lives in its OWN hist: skips must never
+        # pollute serve/service_time or serve/request_time (the SLO
+        # controller's signals measure real forwards only)
+        self.hists: Dict[str, Hist] = {"stream/skip_time": Hist()}
+        self._registry = registry if registry is not None else engine.registry
+        if self._registry is not None:
+            self._registry.register(KIND, _build_frame_delta)
+            self._fn = self._registry.lookup(KIND)
+        else:
+            self._fn = _build_frame_delta()
+        self._stride = max(engine.cfg.network.IMAGE_STRIDE,
+                           engine.cfg.network.RPN_FEAT_STRIDE)
+        engine.stream = self
+
+    @property
+    def gate_enabled(self) -> bool:
+        return self.opts.skip_thresh > 0
+
+    # -- the on-device gate ----------------------------------------------
+
+    def _dispatch_delta(self, a_dev, b_dev, shape) -> float:
+        """One gate dispatch with registry first-seen accounting (the
+        ``device_prep`` recipe: note_dispatch + compile-seconds on first,
+        AOT markers so a warm boot loads instead of compiling)."""
+        reg = self._registry
+        first = reg.note_dispatch(KIND, shape) if reg is not None else False
+        t0 = time.perf_counter() if first else 0.0
+        out = self._fn(a_dev, b_dev)
+        if first:
+            out.block_until_ready()
+            reg.record_compile_seconds(KIND, shape,
+                                       time.perf_counter() - t0)
+        with self._lock:
+            self.counters["delta_dispatches"] += 1
+        telemetry.get().counter("stream/delta_dispatches")
+        return float(out)
+
+    def warmup(self) -> int:
+        """Register + ready one ``frame_delta`` program per orientation
+        bucket (gate on only), so steady-state streaming never compiles —
+        and a warm AOT cache boots with ``aot_hit == programs`` covering
+        the gate like every other program.  Returns the number of
+        programs first-dispatched."""
+        if not self.gate_enabled:
+            return 0
+        import jax
+
+        reg = self._registry
+        before = reg.counters["programs"] if reg is not None else 0
+        short, long_ = self.engine._scale
+        t0 = time.perf_counter()
+        n = 0
+        for h, w in ((short, long_), (long_, short)):
+            staged, _, _, _ = stage_raw_to_bucket(
+                np.zeros((h, w, 3), np.uint8), self.engine._scale,
+                self._stride)
+            dev = jax.device_put(staged)
+            self._dispatch_delta(dev, dev, tuple(staged.shape))
+            n += 1
+        compiled = (reg.counters["programs"] - before
+                    if reg is not None else n)
+        logger.info("stream warmup: %d frame_delta program(s) ready in "
+                    "%.1fs (skip_thresh=%g, max_skip=%d)", compiled,
+                    time.perf_counter() - t0, self.opts.skip_thresh,
+                    self.opts.max_skip)
+        return compiled
+
+    # -- intake ----------------------------------------------------------
+
+    def _state(self, stream_id: str) -> _StreamState:
+        now = time.monotonic()
+        with self._lock:
+            st = self._streams.get(stream_id)
+            if st is None:
+                if len(self._streams) >= self.opts.max_streams:
+                    for sid, s in list(self._streams.items()):
+                        if now - s.last_used > self.opts.idle_ttl_s:
+                            del self._streams[sid]
+                            self.counters["evicted"] += 1
+                if len(self._streams) >= self.opts.max_streams:
+                    raise RejectedError(
+                        f"stream table full ({len(self._streams)}/"
+                        f"{self.opts.max_streams} active) — retire idle "
+                        f"streams or raise --max-streams")
+                st = self._streams[stream_id] = _StreamState(stream_id)
+            st.last_used = now
+            return st
+
+    def submit_frame(self, stream_id: str, seq: int, image: np.ndarray,
+                     deadline_ms: Optional[float] = None) -> FrameResult:
+        """One sequenced frame → :class:`FrameResult`.  Raises
+        :class:`StaleSeqError` on a non-increasing ``seq`` and lets the
+        engine's :class:`RejectedError`/deadline semantics pass through
+        unchanged — a stream frame is an ordinary request plus state."""
+        tel = telemetry.get()
+        state = self._state(stream_id)
+        with state.lock:
+            if seq <= state.last_seq:
+                with self._lock:
+                    self.counters["stale_seq"] += 1
+                tel.counter("stream/stale_seq")
+                raise StaleSeqError(
+                    f"stream {stream_id!r}: seq {seq} <= last accepted "
+                    f"{state.last_seq} (frames must arrive with strictly "
+                    f"increasing seq)")
+            state.last_seq = seq
+            with self._lock:
+                self.counters["frames"] += 1
+            tel.counter("stream/frames")
+            return self._gate_and_submit(state, seq, image, deadline_ms,
+                                         tel)
+
+    def _gate_and_submit(self, state: _StreamState, seq: int, image,
+                         deadline_ms, tel) -> FrameResult:
+        t0 = time.perf_counter()
+        key = cur_dev = staged = None
+        delta = None
+        if self.gate_enabled:
+            import jax
+
+            raw8 = np.asarray(image)
+            if raw8.dtype != np.uint8:
+                raw8 = np.clip(raw8, 0, 255).astype(np.uint8)
+            staged, _, _, _ = stage_raw_to_bucket(
+                raw8, self.engine._scale, self._stride)
+            key = self.engine.bucket_key(image.shape[0], image.shape[1])
+            if state.bucket is not None and state.bucket != key:
+                with self._lock:
+                    self.counters["bucket_switches"] += 1
+                tel.counter("stream/bucket_switches")
+            ref_ok = (state.ref_dev is not None and state.bucket == key
+                      and state.ref_future is not None
+                      and state.ref_future._error is None
+                      and state.generation == self.engine.generation)
+            if ref_ok and state.skip_run >= self.opts.max_skip:
+                # forced refresh: the scene may be static, but cached
+                # detections must not outlive the skip budget
+                ref_ok = False
+                with self._lock:
+                    self.counters["refreshes"] += 1
+                tel.counter("stream/refreshes")
+            if ref_ok:
+                cur_dev = jax.device_put(staged)
+                delta = self._dispatch_delta(cur_dev, state.ref_dev,
+                                             tuple(staged.shape))
+                if delta < self.opts.skip_thresh:
+                    # the skip fast path: cached detections, zero engine
+                    # work — serve_e2e counters and service_time hists
+                    # see nothing (asserted by tests/test_stream.py)
+                    state.skip_run += 1
+                    with self._lock:
+                        self.counters["skipped"] += 1
+                    tel.counter("stream/skipped")
+                    dt = time.perf_counter() - t0
+                    self.hists["stream/skip_time"].observe(dt)
+                    tel.observe("stream/skip_time", dt)
+                    return FrameResult(state.stream_id, seq, True, delta,
+                                       state.ref_future)
+        # full path: an ordinary engine request, tagged with its stream
+        # so the dispatcher's flush bookkeeping can count cross-stream
+        # batch sharing
+        fut = self.engine.submit(image, deadline_ms=deadline_ms,
+                                 stream=state.stream_id)
+        state.ref_future = fut
+        state.generation = self.engine.generation
+        state.skip_run = 0
+        if self.gate_enabled:
+            import jax
+
+            state.bucket = key
+            # the staged pixels become the new on-device reference —
+            # reuse the gate's device_put when the delta ran
+            state.ref_dev = (cur_dev if cur_dev is not None
+                             else jax.device_put(staged))
+        with self._lock:
+            self.counters["forwarded"] += 1
+        tel.counter("stream/forwarded")
+        return FrameResult(state.stream_id, seq, False, delta, fut)
+
+    # -- introspection ---------------------------------------------------
+
+    def active_streams(self) -> int:
+        with self._lock:
+            return len(self._streams)
+
+    def metrics(self) -> dict:
+        """The ``/metrics`` ``stream`` section: manager counters folded
+        with the engine's flush-side stream bookkeeping, the live stream
+        table size, and coalesced-batch occupancy (stream frames per
+        stream-carrying batch slot)."""
+        with self._lock:
+            c = dict(self.counters)
+            active = len(self._streams)
+        ec = self.engine.counters
+        c["batches"] = ec.get("stream_batches", 0)
+        c["batch_frames"] = ec.get("stream_batch_frames", 0)
+        c["coalesced_batches"] = ec.get("stream_coalesced_batches", 0)
+        occupancy = (c["batch_frames"]
+                     / max(c["batches"] * self.engine.opts.batch_size, 1))
+        out = {
+            "active_streams": active,
+            "counters": c,
+            "batch_occupancy": round(occupancy, 4),
+            "skip_fraction": round(c["skipped"] / max(c["frames"], 1), 4),
+            "options": {"skip_thresh": self.opts.skip_thresh,
+                        "max_skip": self.opts.max_skip,
+                        "max_streams": self.opts.max_streams},
+        }
+        latency = {}
+        h = self.hists["stream/skip_time"]
+        for q, tag in ((0.5, "skip_time_p50_ms"), (0.99, "skip_time_p99_ms")):
+            v = h.quantile(q)
+            if v is not None:
+                latency[tag] = round(v * 1e3, 3)
+        if latency:
+            out["latency"] = latency
+        return out

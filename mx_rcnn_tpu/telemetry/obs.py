@@ -396,6 +396,18 @@ def engine_summary(engine) -> dict:
                 "count": 1, "mean": v, "min": v, "max": v, "last": v}
         else:
             counters[f"flywheel/{key}"] = v
+    # streaming state (engine.metrics() carries it only when a
+    # StreamManager is attached): skip/forward/coalescing counters as
+    # stream/*, table size and batch occupancy as gauges — same
+    # one-metrics-path contract as the flywheel fold above
+    st = m.get("stream") or {}
+    for key, v in (st.get("counters") or {}).items():
+        counters[f"stream/{key}"] = v
+    for key in ("active_streams", "batch_occupancy", "skip_fraction"):
+        v = st.get(key)
+        if isinstance(v, (int, float)):
+            gauges[f"stream/{key}"] = {
+                "count": 1, "mean": v, "min": v, "max": v, "last": v}
     gen = m.get("generation", 0)
     gauges.setdefault("serve/generation", {
         "count": 1, "mean": gen, "min": gen, "max": gen, "last": gen})

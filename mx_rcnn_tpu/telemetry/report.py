@@ -87,6 +87,25 @@ FLYWHEEL_COUNTERS = (
     "flywheel/train_failed",
 )
 
+# streaming serving's temporal-reuse progress (serve/stream.py + the
+# engine's stream-aware flush bookkeeping): rendered as their own
+# section — zeros included — whenever the stream carries any stream/*
+# event, so "did frames actually skip, and did streams share batches?"
+# is one greppable block (script/stream_smoke.sh reads it)
+STREAM_COUNTERS = (
+    "stream/frames",
+    "stream/forwarded",
+    "stream/skipped",
+    "stream/delta_dispatches",
+    "stream/refreshes",
+    "stream/bucket_switches",
+    "stream/stale_seq",
+    "stream/evicted",
+    "stream/batches",
+    "stream/batch_frames",
+    "stream/coalesced_batches",
+)
+
 
 def event_files(paths: Iterable[str]) -> List[str]:
     """Expand run dirs to their per-rank event files; pass files through."""
@@ -231,6 +250,8 @@ def render_table(summary: dict) -> str:
         k.startswith("fabric/") for k in summary.get("gauges", {}))
     flywheel = any(k.startswith("flywheel/") for k in counters) or any(
         k.startswith("flywheel/") for k in summary.get("gauges", {}))
+    streaming = any(k.startswith("stream/") for k in counters) or any(
+        k.startswith("stream/") for k in summary.get("gauges", {}))
     if counters:
         lines.append("")
         lines.append(f"{'counter':<34}{'total':>8}")
@@ -249,6 +270,8 @@ def render_table(summary: dict) -> str:
                 continue  # ditto fabric health
             if flywheel and name in FLYWHEEL_COUNTERS:
                 continue  # ditto the flywheel table
+            if streaming and name in STREAM_COUNTERS:
+                continue  # ditto the streaming table
             lines.append(f"{name:<34}{v:>8}")
         lines.append("")
         lines.append(f"{'recovery event':<34}{'total':>8}")
@@ -270,6 +293,11 @@ def render_table(summary: dict) -> str:
             lines.append("")
             lines.append(f"{'flywheel':<34}{'total':>8}")
             for name in FLYWHEEL_COUNTERS:
+                lines.append(f"{name:<34}{counters.get(name, 0):>8}")
+        if streaming:
+            lines.append("")
+            lines.append(f"{'streaming':<34}{'total':>8}")
+            for name in STREAM_COUNTERS:
                 lines.append(f"{name:<34}{counters.get(name, 0):>8}")
     gauges = summary.get("gauges", {})
     if gauges:

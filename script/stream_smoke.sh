@@ -1,0 +1,196 @@
+#!/usr/bin/env bash
+# Streaming serving smoke (CPU-friendly), asserting the --stream
+# contract end to end on a real server:
+#   1. GATE-OFF boot (--stream, threshold 0, cold --program-cache):
+#      /stream answers byte-identically to /predict for the same pixels
+#      (pure coalescing must not change a single byte), then a static
+#      4-stream closed-loop loadgen run records the gate-off
+#      dispatches_per_frame reference.
+#   2. GATE-ON boot (--stream-skip-thresh 3 --stream-max-skip 16, same
+#      cache): the same static profile must skip (skip_fraction above
+#      the --skip-floor) and cut dispatches_per_frame by >= 3x vs the
+#      gate-off reference, with zero steady-state recompiles
+#      (recompiles == warmup_programs) and the compile snapshot
+#      labeling one frame_delta program per orientation bucket.
+#      Writes STREAM_r01.json (mxr_stream_report) for the gate.
+#   3. SECOND gate-on boot over the now-warm cache: EVERY program —
+#      fused forwards and frame_delta gates alike — is an AOT hit
+#      (aot_hit == programs), so streaming adds zero cold-start cost.
+#   4. scripts/perf_gate.py gates the trajectory including the new
+#      stream rows (skip_fraction floor, per-stream p99 ceiling).
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${STREAM_SMOKE_DIR:-/tmp/mxr_stream_smoke}
+deadline_ms=60000
+rm -rf "$dir"
+mkdir -p "$dir"
+cache="$dir/program_cache"
+tinycfg=(--cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)"
+         --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32)
+
+wait_healthy() {
+  python - "$1" "$2" <<'EOF'
+import os, sys, time
+from mx_rcnn_tpu.serve import unix_http_request
+sock, pid = sys.argv[1], int(sys.argv[2])
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("serve.py exited before becoming healthy")
+    try:
+        status, doc = unix_http_request(sock, "GET", "/healthz", timeout=5)
+        if status == 200:
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1)
+sys.exit("serve.py never became healthy")
+EOF
+}
+
+stop() {  # pid — TERM and poll until gone (the server is a subshell
+  # child, so ``wait`` can't reap it here)
+  kill -TERM "$1" 2>/dev/null || true
+  for _ in $(seq 1 100); do
+    kill -0 "$1" 2>/dev/null || return 0
+    sleep 0.2
+  done
+  kill -KILL "$1" 2>/dev/null || true
+}
+
+boot() {  # sock extra-flags... — start serve.py, echo its pid
+  sock="$1"; shift
+  python serve.py --network resnet50 --synthetic --unix-socket "$sock" \
+    --serve-batch 2 --max-delay-ms 50 --max-queue 64 \
+    --deadline-ms "$deadline_ms" --program-cache "$cache" --serve-e2e \
+    "${tinycfg[@]}" "$@" >"$sock.log" 2>&1 &
+  echo $!
+}
+
+dpf_of() {  # loadgen-stdout-file — the static scenario's dispatches_per_frame
+  python - "$1" <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip().startswith("{")]
+row = [r for r in rows if r.get("scenario") == "static"][-1]
+dpf = row.get("dispatches_per_frame")
+assert isinstance(dpf, (int, float)) and dpf > 0, row
+print(dpf)
+EOF
+}
+
+# ---- 1. gate-off boot: byte parity + dispatch reference ------------------
+sock="$dir/off.sock"
+pid=$(boot "$sock" --stream)
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_healthy "$sock" "$pid"
+
+python - "$sock" <<'EOF'
+import json, sys
+import numpy as np
+from mx_rcnn_tpu.serve import encode_image_payload
+from mx_rcnn_tpu.serve.frontend import unix_http_request, unix_http_request_raw
+sock = sys.argv[1]
+rng = np.random.RandomState(3)
+frames = [rng.randint(0, 255, (80, 110, 3), dtype=np.uint8) for _ in range(3)]
+# the reference: each frame as an independent /predict request
+ref = []
+for img in frames:
+    status, resp = unix_http_request(sock, "POST", "/predict",
+                                     encode_image_payload(img), timeout=300)
+    assert status == 200, resp
+    ref.append(resp["detections"])
+# the same pixels as one pipelined /stream burst — gate off, so the
+# responses must be BYTE-identical to the /predict path
+body = "\n".join(
+    json.dumps({"stream_id": "parity", "seq": i + 1,
+                **encode_image_payload(img)})
+    for i, img in enumerate(frames)).encode()
+status, raw, ctype = unix_http_request_raw(sock, "POST", "/stream", body,
+                                           timeout=300)
+assert status == 200 and "ndjson" in ctype, (status, ctype)
+replies = [json.loads(l) for l in raw.decode().splitlines()]
+assert [r["status"] for r in replies] == [200, 200, 200], replies
+for i, (r, dets) in enumerate(zip(replies, ref)):
+    assert r["seq"] == i + 1 and r["skipped"] is False, r
+    assert json.dumps(r["detections"], sort_keys=True) \
+        == json.dumps(dets, sort_keys=True), f"frame {i} diverged"
+print(f"gate-off parity ok: {len(frames)} frame(s) byte-identical "
+      "to /predict")
+EOF
+
+python scripts/loadgen.py --unix-socket "$sock" --streams 4 --fps 4 \
+  --frames 16 --motion static --deadline-ms "$deadline_ms" \
+  | tee "$dir/off.out"
+off_dpf=$(dpf_of "$dir/off.out")
+stop "$pid"
+
+# ---- 2. gate-on boot: the skip gate must pay for itself ------------------
+sock="$dir/on.sock"
+pid=$(boot "$sock" --stream --stream-skip-thresh 3 --stream-max-skip 16)
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_healthy "$sock" "$pid"
+
+python scripts/loadgen.py --unix-socket "$sock" --streams 4 --fps 4 \
+  --frames 16 --motion static --deadline-ms "$deadline_ms" \
+  --skip-floor 0.5 --p99-ceiling-ms 30000 --assert-2xx \
+  --report "${STREAM_OUT:-STREAM_r01.json}" \
+  | tee "$dir/on.out"
+on_dpf=$(dpf_of "$dir/on.out")
+
+python - "$off_dpf" "$on_dpf" <<'EOF'
+import sys
+off, on = float(sys.argv[1]), float(sys.argv[2])
+# the tentpole's acceptance: the gate cuts device work >= 3x on a
+# static profile vs the identical gate-off stream set
+assert on > 0 and off / on >= 3.0, \
+    f"dispatches_per_frame {off} -> {on}: less than the required 3x win"
+print(f"skip win ok: dispatches_per_frame {off} -> {on} "
+      f"({off / on:.1f}x fewer dispatches)")
+EOF
+
+python - "$sock" <<'EOF'
+import sys
+from mx_rcnn_tpu.serve import unix_http_request
+status, m = unix_http_request(sys.argv[1], "GET", "/metrics", timeout=30)
+assert status == 200
+c, st = m["counters"], m["stream"]
+assert st["counters"]["skipped"] > 0, st
+assert st["counters"]["frames"] > 0, st
+assert st["skip_fraction"] > 0, st
+# zero steady-state recompiles: streaming traffic over the warm AOT
+# cache compiled nothing beyond warmup, and the gate programs are
+# ordinary kind-labeled registry citizens (one per orientation)
+assert c["recompiles"] == c["warmup_programs"], c
+rows = m["compile"]["programs"]
+assert sum(p["kind"] == "frame_delta" for p in rows) == 2, rows
+print(f"gate-on metrics ok: skip_fraction={st['skip_fraction']}, "
+      f"{st['counters']['skipped']}/{st['counters']['frames']} frames "
+      f"skipped, 0 steady-state recompiles")
+EOF
+stop "$pid"
+
+# ---- 3. warm gate-on boot: the gate programs AOT-hit like the rest -------
+sock="$dir/warm.sock"
+pid=$(boot "$sock" --stream --stream-skip-thresh 3 --stream-max-skip 16)
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_healthy "$sock" "$pid"
+python - "$sock" <<'EOF'
+import sys
+from mx_rcnn_tpu.serve import unix_http_request
+status, m = unix_http_request(sys.argv[1], "GET", "/metrics", timeout=30)
+assert status == 200
+rc = m["compile"]["counters"]
+kinds = {p["kind"] for p in m["compile"]["programs"]}
+assert "frame_delta" in kinds, kinds
+assert rc["programs"] > 0
+assert rc["aot_hit"] == rc["programs"], rc
+print(f"aot warm start ok: {rc['aot_hit']}/{rc['programs']} program(s) "
+      f"incl. frame_delta served from the persistent cache")
+EOF
+stop "$pid"
+trap - EXIT
+
+# ---- 4. gate the trajectory including the stream rows --------------------
+python scripts/perf_gate.py
+echo "stream smoke ok"

@@ -73,6 +73,31 @@ Capture check (ISSUE 13): with ``--capture-check`` the target's
 whole run and the delta must match ``2xx submits / sample_every``
 within ``--capture-tolerance`` (exit 1 otherwise) — the smoke-script
 guard against silent capture loss.
+
+Stream mode (ISSUE 14): ``--streams N`` switches to the camera model —
+N concurrent streams, each a CLOSED loop at ``--fps`` over ONE
+persistent keep-alive connection to ``POST /stream``, frames sequenced
+per stream.  Closed-loop is deliberate here (the opposite of the
+request mode above): a camera cannot fire frame k+1 before frame k's
+slot, so a slow server shows up as ``frames_dropped`` (scheduled slots
+abandoned because the sender was more than one frame interval late),
+not as unbounded in-flight pileup.  ``--motion`` picks the per-frame
+pixel dynamics (repeatable — one scenario per profile):
+
+* ``static``    — fixed scene + per-frame sensor noise on ~5% of pixels:
+  the skip gate's best case.
+* ``pan``       — the scene translates a few pixels per frame: every
+  frame differs everywhere, the gate must NOT skip.
+* ``scene-cut`` — a new random scene every ``--cut-every`` frames,
+  static between cuts: exercises both gate edges.
+
+Each scenario prints one JSON line and contributes one row to the
+``--report`` doc, which in stream mode uses schema ``mxr_stream_report``
+(per-stream p99 list, max-over-streams ``p99_ms``, ``frames_dropped``,
+client-observed ``skip_fraction`` from response ``skipped`` flags, and
+``dispatches_per_frame`` diffed from the server's ``/metrics`` engine
+counters).  ``--skip-floor``/``--p99-ceiling-ms`` attach the
+``perf_gate.py`` floor/ceiling fields to the rows the gate scores.
 """
 
 import argparse
@@ -91,8 +116,10 @@ from mx_rcnn_tpu.serve.frontend import (encode_image_payload,  # noqa: E402
                                         unix_http_request)
 
 REPORT_SCHEMA = "mxr_slo_report"
+STREAM_REPORT_SCHEMA = "mxr_stream_report"
 REPORT_VERSION = 1
 SCENARIOS = ("steady", "bursty", "size-mix")
+MOTIONS = ("static", "pan", "scene-cut")
 
 
 def parse_args(argv=None):
@@ -147,6 +174,32 @@ def parse_args(argv=None):
                     dest="capture_tolerance",
                     help="--capture-check: allowed relative deviation "
                          "of captured-delta from the expected count")
+    ap.add_argument("--streams", type=int, default=0,
+                    help="stream mode: this many concurrent sequenced "
+                         "streams against POST /stream (0 = classic "
+                         "request mode)")
+    ap.add_argument("--fps", type=float, default=10.0,
+                    help="stream mode: per-stream frame rate (0 = send "
+                         "frames back-to-back)")
+    ap.add_argument("--frames", type=int, default=32,
+                    help="stream mode: frames per stream")
+    ap.add_argument("--motion", action="append", choices=MOTIONS,
+                    dest="motions", default=None,
+                    help="stream mode: motion profile (repeatable — one "
+                         "scenario per profile; default static)")
+    ap.add_argument("--cut-every", type=int, default=8, dest="cut_every",
+                    help="scene-cut profile: frames between scene "
+                         "changes")
+    ap.add_argument("--skip-floor", type=float, default=0.0,
+                    dest="skip_floor",
+                    help="stream mode: attach this skip_fraction floor "
+                         "to the static-profile report row (what "
+                         "perf_gate.py enforces)")
+    ap.add_argument("--p99-ceiling-ms", type=float, default=0.0,
+                    dest="p99_ceiling_ms",
+                    help="stream mode: attach this per-stream p99 "
+                         "ceiling to every report row (what perf_gate.py "
+                         "enforces)")
     return ap.parse_args(argv)
 
 
@@ -373,12 +426,259 @@ def assert_2xx_failure(results):
     return msg
 
 
+# -- stream mode (ISSUE 14) ----------------------------------------------
+
+
+class StreamConn:
+    """One persistent keep-alive HTTP connection (TCP or Unix socket) —
+    the per-stream transport.  A camera holds its connection open; a
+    transport failure reconnects once, then reports status 0."""
+
+    def __init__(self, args):
+        self.args = args
+        self.conn = None
+
+    def _connect(self):
+        a = self.args
+        if a.unix_socket:
+            sock_path, timeout = a.unix_socket, a.timeout
+
+            class Conn(http.client.HTTPConnection):
+                def __init__(self):
+                    super().__init__("localhost", timeout=timeout)
+
+                def connect(self):
+                    import socket as _socket
+                    self.sock = _socket.socket(_socket.AF_UNIX,
+                                               _socket.SOCK_STREAM)
+                    self.sock.settimeout(timeout)
+                    self.sock.connect(sock_path)
+
+            self.conn = Conn()
+        else:
+            self.conn = http.client.HTTPConnection(a.host, a.port,
+                                                   timeout=a.timeout)
+
+    def post_frame(self, doc):
+        """One frame → (per-frame status, response doc).  The HTTP
+        envelope is 200 whenever the body parsed; the status that matters
+        is the per-line one inside the NDJSON reply."""
+        body = (json.dumps(doc) + "\n").encode()
+        for attempt in (0, 1):
+            try:
+                if self.conn is None:
+                    self._connect()
+                self.conn.request(
+                    "POST", "/stream", body=body,
+                    headers={"Content-Type": "application/x-ndjson"})
+                resp = self.conn.getresponse()
+                raw = resp.read()
+                if resp.status != 200:
+                    return resp.status, {}
+                line = raw.decode().strip().splitlines()
+                out = json.loads(line[-1]) if line else {}
+                return int(out.get("status", 0)), out
+            except (OSError, ValueError) as e:
+                self.close()
+                if attempt:
+                    return 0, {"error": f"{type(e).__name__}: {e}"}
+        return 0, {}
+
+    def close(self):
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            finally:
+                self.conn = None
+
+
+def make_stream_frames(rng, motion, n, h, w, cut_every=8):
+    """``n`` consecutive (h, w, 3) uint8 frames of one motion profile."""
+    scene = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+    frames = []
+    for i in range(n):
+        if motion == "pan":
+            # the whole scene translates: every pixel changes, mean
+            # absolute delta is large — the gate must take the full path
+            frames.append(np.roll(scene, 3 * (i + 1), axis=1))
+        elif motion == "scene-cut":
+            if i and i % max(cut_every, 1) == 0:
+                scene = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+            frames.append(scene.copy())
+        else:  # static: ±1 sensor noise on ~5% of pixels
+            f = scene.copy()
+            k = max((h * w) // 20, 1)
+            ys = rng.randint(0, h, k)
+            xs = rng.randint(0, w, k)
+            f[ys, xs] = np.clip(
+                f[ys, xs].astype(np.int16)
+                + rng.choice((-1, 1), (k, 1)), 0, 255).astype(np.uint8)
+            frames.append(f)
+    return frames
+
+
+def server_counters(args, timeout=10.0):
+    """The target's ``/metrics`` engine counters (``{}`` when
+    unreachable) — diffed around a scenario for ``dispatches_per_frame``."""
+    try:
+        if args.unix_socket:
+            status, doc = unix_http_request(args.unix_socket, "GET",
+                                            "/metrics", timeout=timeout)
+        else:
+            conn = http.client.HTTPConnection(args.host, args.port,
+                                              timeout=timeout)
+            try:
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                status, doc = resp.status, json.loads(resp.read())
+            finally:
+                conn.close()
+    except (OSError, ValueError):
+        return {}
+    if status != 200 or not isinstance(doc, dict):
+        return {}
+    return doc.get("counters") or {}
+
+
+def run_stream_scenario(args, motion, idx):
+    """One motion profile: ``--streams`` concurrent closed-loop senders.
+    Returns ``(per_stream_results, per_stream_dropped, wall_s)`` where
+    results[s] is a list of ``(status, latency_s, skipped)``."""
+    per_results = [[] for _ in range(args.streams)]
+    per_dropped = [0] * args.streams
+    interval = 1.0 / args.fps if args.fps > 0 else 0.0
+
+    def run_one(si):
+        rng = np.random.RandomState(args.seed + 1000 * idx + si)
+        h, w = ((args.short, args.long_) if si % 2 == 0
+                else (args.long_, args.short))
+        frames = make_stream_frames(rng, motion, args.frames, h, w,
+                                    cut_every=args.cut_every)
+        conn = StreamConn(args)
+        seq = 0
+        t0 = time.perf_counter()
+        for i, frame in enumerate(frames):
+            target = t0 + i * interval
+            now = time.perf_counter()
+            if interval and now > target + interval:
+                # more than a full slot late: a camera drops the frame
+                # rather than queueing a stale one
+                per_dropped[si] += 1
+                continue
+            if now < target:
+                time.sleep(target - now)
+            seq += 1
+            doc = {"stream_id": f"{motion}-{si}", "seq": seq,
+                   **encode_image_payload(frame)}
+            if args.deadline_ms > 0:
+                doc["deadline_ms"] = args.deadline_ms
+            ts = time.perf_counter()
+            status, resp = conn.post_frame(doc)
+            per_results[si].append((status, time.perf_counter() - ts,
+                                    bool(resp.get("skipped"))))
+        conn.close()
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=run_one, args=(s,))
+               for s in range(args.streams)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return per_results, per_dropped, time.perf_counter() - t_start
+
+
+def summarize_streams(args, motion, per_results, per_dropped, wall):
+    """One scenario's ``mxr_stream_report`` row.  ``p99_ms`` is the MAX
+    over per-stream p99s — the SLO a fleet operator actually owes each
+    camera — with the full per-stream list alongside."""
+    flat = [r for rs in per_results for r in rs]
+    status_counts = {}
+    for r in flat:
+        status_counts[str(r[0])] = status_counts.get(str(r[0]), 0) + 1
+    ok = [r for r in flat if 200 <= r[0] < 300]
+    per_stream_p99 = []
+    for rs in per_results:
+        lat = [r[1] for r in rs if 200 <= r[0] < 300]
+        per_stream_p99.append(
+            round(float(np.percentile(np.asarray(lat) * 1e3, 99)), 3)
+            if lat else None)
+    p99s = [p for p in per_stream_p99 if p is not None]
+    all_lat = np.asarray([r[1] for r in ok]) * 1e3
+    skipped = sum(1 for r in ok if r[2])
+    return {
+        "name": motion,
+        "streams": args.streams,
+        "fps": args.fps,
+        "frames_per_stream": args.frames,
+        "frames_sent": len(flat),
+        "frames_dropped": sum(per_dropped),
+        "status": dict(sorted(status_counts.items())),
+        "p50_ms": (round(float(np.percentile(all_lat, 50)), 3)
+                   if ok else None),
+        "p99_ms": max(p99s) if p99s else None,
+        "per_stream_p99_ms": per_stream_p99,
+        "error_rate": round((len(flat) - len(ok)) / max(len(flat), 1), 4),
+        "skip_fraction": round(skipped / max(len(ok), 1), 4),
+        "imgs_per_sec": round(len(ok) / wall, 3) if wall > 0 else None,
+        "wall_s": round(wall, 3),
+    }
+
+
+def stream_main(args):
+    """Stream-mode driver: one scenario per ``--motion`` profile, one
+    ``mxr_stream_report`` doc for the gate."""
+    motions = args.motions or ["static"]
+    rows = []
+    all_status = []
+    for idx, motion in enumerate(motions):
+        before = server_counters(args, timeout=args.timeout)
+        per_results, per_dropped, wall = run_stream_scenario(
+            args, motion, idx)
+        after = server_counters(args, timeout=args.timeout)
+        row = summarize_streams(args, motion, per_results, per_dropped,
+                                wall)
+        if after and row["frames_sent"]:
+            row["dispatches_per_frame"] = round(
+                (after.get("dispatches", 0) - before.get("dispatches", 0))
+                / row["frames_sent"], 4)
+        if motion == "static" and args.skip_floor > 0:
+            row["skip_fraction_floor"] = args.skip_floor
+        if args.p99_ceiling_ms > 0:
+            row["p99_ceiling_ms"] = args.p99_ceiling_ms
+        rows.append(row)
+        all_status.extend(r[0] for rs in per_results for r in rs)
+        print(json.dumps({"scenario": motion, **row}))
+
+    if args.report:
+        doc = {"schema": STREAM_REPORT_SCHEMA, "version": REPORT_VERSION,
+               "scenarios": rows}
+        with open(args.report, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+
+    if args.assert_2xx:
+        bad = [s for s in all_status if not 200 <= s < 300]
+        if bad:
+            counts = {}
+            for s in bad:
+                counts[s] = counts.get(s, 0) + 1
+            parts = ", ".join(
+                f"{ct}x status {st}" if st else f"{ct}x transport error"
+                for st, ct in sorted(counts.items()))
+            print(f"loadgen: --assert-2xx failed: {len(bad)}/"
+                  f"{len(all_status)} frames were not 2xx ({parts})",
+                  file=sys.stderr)
+            sys.exit(1)
+
+
 def main(argv=None):
     args = parse_args(argv)
     if bool(args.unix_socket) == bool(args.port):
         raise SystemExit("pass exactly one of --port / --unix-socket")
     if args.fabric and not args.port:
         raise SystemExit("--fabric needs a TCP router (--port)")
+    if args.streams > 0:
+        return stream_main(args)
 
     scenarios = args.scenarios or [None]
     report_rows = []

@@ -24,6 +24,12 @@ trajectory of the same metric.  Two row dialects:
   the gate that stops "fast but drops bursts" from merging: p50/p99 and
   error-rate per loadgen scenario are scored alongside imgs/sec.
 
+Two absolute dialects score the NEWEST run alone (properties, not
+trends): ``floor`` rows fail below their bound (replica linearity,
+flywheel loop closure, the streaming skip_fraction), ``ceiling`` rows
+fail above it (the per-stream p99 SLO an ``mxr_stream_report`` pins
+via ``--p99-ceiling-ms``).
+
 Comparisons never cross ``baseline_method``: BENCH_BASELINE.json holds
 one baseline per dispatch method (staged ``value`` vs chain
 ``value_chain``), so a chain-method 1.0 ratio right after a cross-method
@@ -81,6 +87,16 @@ HOST_PREP_ABS_SLACK_MS = 2.0
 # hot-reloaded at least one replay-trained checkpoint generation
 FLYWHEEL_MINED_FRACTION_FLOOR = 0.01
 FLYWHEEL_GENERATION_FLOOR = 1.0
+# streaming (mxr_stream_report + the serve-bench stream fields):
+# dispatches_per_frame is a counter ratio, not wall-clock, but batch
+# fill still varies with thread scheduling — allow a quarter-dispatch
+# of absolute noise before the relative threshold applies.  The bench's
+# static-profile skip_fraction floor is far below what the gate
+# actually achieves (~0.9 with max_skip=16 over 32 frames) so only a
+# broken gate trips it — the BENCH_r08 lesson: new metric families get
+# their own series and conservative first thresholds.
+STREAM_DPF_ABS_SLACK = 0.25
+BENCH_SKIP_FRACTION_FLOOR = 0.5
 
 
 def slo_report_rows(doc: dict) -> list:
@@ -196,6 +212,47 @@ def flywheel_report_rows(doc: dict) -> list:
     return rows
 
 
+def stream_report_rows(doc: dict) -> list:
+    """Expand an ``mxr_stream_report`` (scripts/loadgen.py --streams)
+    into rows — per motion profile: per-stream p99 (a CEILING row when
+    the run pinned ``p99_ceiling_ms``, scored on the newest run alone
+    like a floor; a direction=down trend row otherwise), error_rate,
+    ``dispatches_per_frame`` (direction=down: the coalescing/skip win
+    must not erode), and — when the run pinned ``skip_fraction_floor``
+    (the static profile) — a skip_fraction FLOOR row."""
+    rows = []
+    for sc in doc.get("scenarios", []):
+        name = sc.get("name", "?")
+        p99 = sc.get("p99_ms")
+        if isinstance(p99, (int, float)):
+            row = {"metric": f"stream_{name}_p99_ms", "value": p99,
+                   "unit": "ms", "direction": "down"}
+            ceil = sc.get("p99_ceiling_ms")
+            if isinstance(ceil, (int, float)) and ceil > 0:
+                row = {"metric": f"stream_{name}_p99_ms", "value": p99,
+                       "unit": "ms", "ceiling": ceil}
+            rows.append(row)
+        er = sc.get("error_rate")
+        if isinstance(er, (int, float)):
+            rows.append({"metric": f"stream_{name}_error_rate",
+                         "value": er, "unit": "fraction",
+                         "direction": "down",
+                         "abs_slack": ERROR_RATE_ABS_SLACK})
+        dpf = sc.get("dispatches_per_frame")
+        if isinstance(dpf, (int, float)):
+            rows.append({"metric": f"stream_{name}_dispatches_per_frame",
+                         "value": dpf, "unit": "ratio",
+                         "direction": "down",
+                         "abs_slack": STREAM_DPF_ABS_SLACK})
+        floor = sc.get("skip_fraction_floor")
+        sf = sc.get("skip_fraction")
+        if (isinstance(floor, (int, float)) and floor > 0
+                and isinstance(sf, (int, float))):
+            rows.append({"metric": f"stream_{name}_skip_fraction",
+                         "value": sf, "unit": "fraction", "floor": floor})
+    return rows
+
+
 def load_rows(path: str) -> list:
     """Extract metric rows from one trajectory artifact.  Shapes seen in
     the wild: the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
@@ -213,6 +270,8 @@ def load_rows(path: str) -> list:
         return fabric_report_rows(doc)
     if isinstance(doc, dict) and doc.get("schema") == "mxr_flywheel_report":
         return flywheel_report_rows(doc)
+    if isinstance(doc, dict) and doc.get("schema") == "mxr_stream_report":
+        return stream_report_rows(doc)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return startup_rows([doc["parsed"]])
     if isinstance(doc, dict) and "metric" in doc:
@@ -249,6 +308,21 @@ def startup_rows(rows: list) -> list:
                 out.append({"metric": f"{row.get('metric', '?')}_{field}",
                             "value": v, "unit": unit, "direction": "down",
                             "abs_slack": slack})
+        # serve-bench stream phase (bench.py --serve-stream): coalescing
+        # and skip wins as their own series keyed by the parent metric —
+        # never scored against the request/response throughput rows
+        v = row.get("dispatches_per_frame")
+        if isinstance(v, (int, float)):
+            out.append({"metric":
+                        f"{row.get('metric', '?')}_dispatches_per_frame",
+                        "value": v, "unit": "ratio", "direction": "down",
+                        "abs_slack": STREAM_DPF_ABS_SLACK})
+        v = row.get("skip_fraction")
+        if isinstance(v, (int, float)):
+            out.append({"metric": f"{row.get('metric', '?')}_skip_fraction",
+                        "value": v, "unit": "fraction",
+                        "floor": row.get("skip_fraction_floor",
+                                         BENCH_SKIP_FRACTION_FLOOR)})
         ev = row.get("eval")
         if isinstance(ev, dict):
             sp = ev.get("speedup_vs_serial")
@@ -295,7 +369,7 @@ def build_series(paths: list) -> dict:
     for path in paths:
         for row in load_rows(path):
             if ("vs_baseline" not in row and row.get("direction") != "down"
-                    and "floor" not in row):
+                    and "floor" not in row and "ceiling" not in row):
                 continue  # BENCH_BASELINE.json: not a trajectory point
             key = (row.get("metric", "?"), row.get("baseline_method"))
             series.setdefault(key, []).append((path, row))
@@ -320,6 +394,18 @@ def gate(series: dict, threshold: float = GATE_THRESHOLD) -> list:
                     f"{metric}: value {v:g} "
                     f"({os.path.basename(newest_path)}) is below the "
                     f"required floor {floor:g}")
+            continue
+        if any("ceiling" in r for _, r in hist):
+            # absolute ceiling (per-stream p99 SLO): the floor dialect
+            # mirrored — newest run scored alone, fails when ABOVE
+            newest_path, newest_row = hist[-1]
+            v, ceil = newest_row.get("value"), newest_row.get("ceiling")
+            if (isinstance(v, (int, float))
+                    and isinstance(ceil, (int, float)) and v > ceil):
+                failures.append(
+                    f"{metric}: value {v:g} "
+                    f"({os.path.basename(newest_path)}) exceeds the "
+                    f"required ceiling {ceil:g}")
             continue
         if any(r.get("direction") == "down" for _, r in hist):
             # lower-is-better: score the raw value against the best
@@ -373,6 +459,8 @@ def trend_table(series: dict) -> str:
                 note = "  (baseline recorded this run — not scored)"
             if "floor" in row:
                 score = f"floor={row['floor']:g}"
+            elif "ceiling" in row:
+                score = f"ceiling={row['ceiling']:g}"
             elif row.get("direction") == "down":
                 score = "direction=down"
             else:
@@ -389,11 +477,13 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*",
                     help="trajectory files (default: --dir/BENCH_r*.json "
                          "+ --dir/SLO_r*.json + --dir/REPLICA_r*.json + "
-                         "--dir/FABRIC_r*.json + --dir/FLYWHEEL_r*.json)")
+                         "--dir/FABRIC_r*.json + --dir/FLYWHEEL_r*.json "
+                         "+ --dir/STREAM_r*.json)")
     ap.add_argument("--dir", default=".",
                     help="where to glob BENCH_r*.json / SLO_r*.json / "
                          "REPLICA_r*.json / FABRIC_r*.json / "
-                         "FLYWHEEL_r*.json when no paths given")
+                         "FLYWHEEL_r*.json / STREAM_r*.json when no "
+                         "paths given")
     ap.add_argument("--threshold", type=float, default=GATE_THRESHOLD,
                     help="allowed fractional drop vs the best prior run "
                          "(default 0.10)")
@@ -408,7 +498,8 @@ def main(argv=None) -> int:
         + sorted(glob.glob(os.path.join(args.dir, "SLO_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "REPLICA_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "FABRIC_r*.json")))
-        + sorted(glob.glob(os.path.join(args.dir, "FLYWHEEL_r*.json"))))
+        + sorted(glob.glob(os.path.join(args.dir, "FLYWHEEL_r*.json")))
+        + sorted(glob.glob(os.path.join(args.dir, "STREAM_r*.json"))))
     if not paths:
         print("perf_gate: no BENCH_*.json / SLO_*.json files found",
               file=sys.stderr)

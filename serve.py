@@ -82,6 +82,26 @@ def parse_args():
                              "readback per batch.  Off (default) "
                              "reproduces the classic host-prep path "
                              "byte-for-byte")
+    parser.add_argument("--stream", action="store_true",
+                        help="enable POST /stream sequenced-frame "
+                             "streaming (single-process mode only): "
+                             "per-stream state over the same batcher, so "
+                             "same-bucket frames from different streams "
+                             "coalesce into shared dispatches")
+    parser.add_argument("--stream-skip-thresh", type=float, default=0.0,
+                        dest="stream_skip_thresh",
+                        help="frame-delta skip gate: mean absolute uint8 "
+                             "pixel delta (on-device, vs the stream's "
+                             "reference frame) below which a frame "
+                             "answers with cached detections and no "
+                             "forward.  0 (default) disables the gate — "
+                             "gate-off streaming is byte-identical to "
+                             "per-frame /predict")
+    parser.add_argument("--stream-max-skip", type=int, default=30,
+                        dest="stream_max_skip",
+                        help="force a full forward after this many "
+                             "consecutive skips, bounding detection "
+                             "staleness on static scenes")
     parser.add_argument("--max-queue", type=int, default=64,
                         dest="max_queue",
                         help="bounded-queue backpressure: submits beyond "
@@ -247,7 +267,8 @@ def main_single(args):
     """The classic single-process server (--replicas 1), plus optional
     in-process checkpoint hot-reload when --watch-checkpoints is set."""
     from mx_rcnn_tpu.serve import (CheckpointWatcher, ControllerOptions,
-                                   SLOController, make_server,
+                                   SLOController, StreamManager,
+                                   StreamOptions, make_server,
                                    reload_engine_params, warmup)
 
     if not args.unix_socket and not args.port:
@@ -263,6 +284,15 @@ def main_single(args):
                               configure_telemetry=True)
     predictor, engine = _build_engine(args, cfg)
     warmup(engine)
+    stream = None
+    if args.stream:
+        stream = StreamManager(engine, StreamOptions(
+            skip_thresh=args.stream_skip_thresh,
+            max_skip=args.stream_max_skip))
+        # gate on: ready the per-bucket frame_delta programs now, like
+        # warmup() readied the forwards — steady-state streaming never
+        # compiles, and a warm AOT cache covers the gate too
+        stream.warmup()
     controller = None
     if args.target_p99_ms > 0:
         controller = SLOController(engine, ControllerOptions(
@@ -283,7 +313,8 @@ def main_single(args):
         watcher.start()
 
     server = make_server(engine, port=args.port or None, host=args.host,
-                         unix_socket=args.unix_socket or None)
+                         unix_socket=args.unix_socket or None,
+                         stream=stream)
     # serve_forever on a worker thread; the main thread parks on an event
     # the signal handlers set — shutdown() called from the serving thread
     # itself would deadlock its poll loop
@@ -512,6 +543,16 @@ def choose_mode(args) -> str:
 
 
 def main(args):
+    mode = choose_mode(args)
+    if getattr(args, "stream", False) and mode != "single":
+        # stream state (reference frames, seq high-water marks) lives in
+        # ONE engine's process; routing frames of a stream across
+        # replicas/members would silently break the skip gate and seq
+        # ordering, so refuse rather than degrade
+        raise SystemExit(
+            f"--stream requires single-process mode (got mode "
+            f"{mode!r}: drop --replicas/--fabric/--join/--pool-file "
+            f"or run one streaming server per device)")
     return {"replica": main_replica, "fabric": main_fabric,
             "member": main_member, "plane": main_plane,
             "single": main_single}[choose_mode(args)](args)
