@@ -667,6 +667,120 @@ def bench_serve(batch: int, network: str = "resnet101",
             stream_dpf, stream_skip)
 
 
+def bench_serve_pool(batch: int, network: str = "resnet101",
+                     n_models: int = 2):
+    """Aggregate steady-state imgs/sec through a :class:`ModelPool` of
+    ``n_models`` same-architecture, independent-weight models — the
+    multi-model serving tax in one number.  Same transport-independent
+    shape as ``bench_serve`` (submits enter at the engine, no HTTP) but
+    requests round-robin across the per-model engines, so the measured
+    rate includes cross-model dispatch interleaving and scheduler
+    switches.  Gated as its own ``_mmN`` series against the
+    single-model ``serve_imgs_per_sec`` floor via MULTIMODEL reports,
+    never compared to it directly."""
+    import threading
+
+    from mx_rcnn_tpu.eval.tester import Predictor
+    from mx_rcnn_tpu.models import build_model, init_params
+    from mx_rcnn_tpu.serve import (ModelPool, RejectedError, ServeEngine,
+                                   ServeOptions, warmup)
+    from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
+
+    cfg = make_cfg(network)
+    model = build_model(cfg)
+    pool = ModelPool().start()
+    mids = [f"m{i}" for i in range(n_models)]
+    t_w = time.perf_counter()
+    for i, mid in enumerate(mids):
+        params = denormalize_for_save(
+            init_params(model, cfg, jax.random.PRNGKey(i), batch), cfg)
+        pred = Predictor(model, params, cfg)
+        engine = ServeEngine(pred, cfg, ServeOptions(
+            batch_size=batch, max_delay_ms=5.0,
+            max_queue=max(8 * batch, 16)))
+        engine.start(external=True)
+        pool.add_model(mid, cfg, pred, engine)
+        # warm THIS model before building the next (jax cache-dir order)
+        warmup(engine)
+    warmup_compile_s = time.perf_counter() - t_w
+    cold_start_s = time.perf_counter() - _PROC_T0
+
+    short, long_ = (int(s) for s in cfg.tpu.SCALES[0])
+    rng = np.random.RandomState(0)
+    # per-model, per-orientation counts stay a multiple of batch so the
+    # steady state runs full batches on every engine
+    wave = 8 * batch * n_models
+    imgs = []
+    for i in range(wave):
+        h, w = (short, long_) if (i // n_models) % 2 == 0 else (long_, short)
+        dh, dw = rng.randint(0, 32, 2)
+        imgs.append(rng.randint(0, 255, (max(h - dh, 16), max(w - dw, 16), 3),
+                                dtype=np.uint8))
+
+    def submit_retry(i):
+        engine = pool.engine_for(mids[i % n_models])
+        while True:
+            try:
+                return engine.submit(imgs[i], deadline_ms=0)
+            except RejectedError:
+                time.sleep(2e-3)
+
+    feeders = 4
+    best = None
+    try:
+        for _ in range(4):
+            futs = [None] * wave
+            t0 = time.time()
+
+            def feed(t):
+                for i in range(t, wave, feeders):
+                    futs[i] = submit_retry(i)
+
+            ts = [threading.Thread(target=feed, args=(t,))
+                  for t in range(feeders)]
+            for th in ts:
+                th.start()
+            for th in ts:
+                th.join()
+            for f in futs:
+                f.result(timeout=600.0)
+            best = max(best or 0.0, wave / (time.time() - t0))
+    finally:
+        # worst tenant's tail, not the blended one: max over per-model
+        # quantiles — the SLO a pool operator owes EACH model
+        p50s, p99s = [], []
+        agg = {}
+        for mid in mids:
+            engine = pool.engine_for(mid)
+            h = engine.hists["serve/request_time"]
+            q50, q99 = h.quantile(0.5), h.quantile(0.99)
+            if q50 is not None:
+                p50s.append(q50)
+            if q99 is not None:
+                p99s.append(q99)
+            for k, v in engine.counters.items():
+                if isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+        readback_per_img = (agg.get("readback_bytes", 0)
+                            / max(agg.get("served", 0), 1))
+        host_prep_ms = (agg.get("host_prep_ms_total", 0.0)
+                        / max(agg.get("requests", 0), 1))
+        sched = dict(pool.counters)
+        pool.stop()
+    pool_doc = {
+        "models": n_models,
+        "sched_batches": sched["sched_batches"],
+        "sched_switches": sched["sched_switches"],
+        "switches_per_batch": round(
+            sched["sched_switches"] / max(sched["sched_batches"], 1), 4),
+    }
+    return (best,
+            (round(max(p50s) * 1e3, 3) if p50s else None),
+            (round(max(p99s) * 1e3, 3) if p99s else None),
+            round(cold_start_s, 3), round(warmup_compile_s, 3),
+            round(readback_per_img, 1), round(host_prep_ms, 3), pool_doc)
+
+
 def bench_infer_mask(batch: int, network: str = "resnet101_fpn_mask"):
     """Full Mask R-CNN eval loop (VERDICT round-2 item 6): pred_eval with
     with_masks=True — forward + per-class NMS + mask chunk drain + 28×28
@@ -775,6 +889,14 @@ def main():
                          "with the frame-delta gate on) and report "
                          "dispatches_per_frame + skip_fraction as their "
                          "own gated series")
+    ap.add_argument("--serve-models", type=int, default=0,
+                    dest="serve_models",
+                    help="serve mode: run N same-architecture, "
+                         "independent-weight models behind one ModelPool "
+                         "and report AGGREGATE imgs/sec (requests round-"
+                         "robin across models).  Metric suffixed _mmN — "
+                         "its own series; the JSON carries the pool's "
+                         "scheduler counters")
     ap.add_argument("--pipeline-images", type=int, default=32,
                     dest="pipeline_images",
                     help="pipeline mode: synthetic roidb size per epoch")
@@ -912,13 +1034,25 @@ def main():
         value = bench_infer_mask(args.batch, args.network)
         metric = "infer_imgs_per_sec_mask_eval"
     elif args.mode == "serve":
-        (value, serve_p50_ms, serve_p99_ms, serve_cold_start_s,
-         serve_warmup_s, serve_readback_b, serve_prep_ms,
-         serve_stream_dpf, serve_stream_skip) = bench_serve(
-             args.batch, args.network, serve_e2e=args.serve_e2e,
-             stream=args.serve_stream)
-        metric = ("serve_imgs_per_sec_e2e" if args.serve_e2e
-                  else "serve_imgs_per_sec")
+        serve_pool_doc = None
+        if args.serve_models >= 2:
+            if args.serve_e2e or args.serve_stream:
+                raise SystemExit("--serve-models is exclusive with "
+                                 "--serve-e2e / --serve-stream")
+            (value, serve_p50_ms, serve_p99_ms, serve_cold_start_s,
+             serve_warmup_s, serve_readback_b, serve_prep_ms,
+             serve_pool_doc) = bench_serve_pool(
+                 args.batch, args.network, args.serve_models)
+            serve_stream_dpf = serve_stream_skip = None
+            metric = f"serve_imgs_per_sec_mm{args.serve_models}"
+        else:
+            (value, serve_p50_ms, serve_p99_ms, serve_cold_start_s,
+             serve_warmup_s, serve_readback_b, serve_prep_ms,
+             serve_stream_dpf, serve_stream_skip) = bench_serve(
+                 args.batch, args.network, serve_e2e=args.serve_e2e,
+                 stream=args.serve_stream)
+            metric = ("serve_imgs_per_sec_e2e" if args.serve_e2e
+                      else "serve_imgs_per_sec")
         infer_method = "engine"  # not comparable to forward-only rows
     elif args.mode == "eval":
         eval_rates = bench_eval(args.batch, args.network)
@@ -1062,6 +1196,10 @@ def main():
             out["dispatches_per_frame"] = serve_stream_dpf
         if serve_stream_skip is not None:
             out["skip_fraction"] = serve_stream_skip
+        # multi-model phase (--serve-models): the pool's scheduler
+        # counters ride along for the MULTIMODEL evidence trail
+        if serve_pool_doc is not None:
+            out["pool"] = serve_pool_doc
     if opt_acc is not None:
         out["opt_acc"] = opt_acc
     if eval_rates is not None:

@@ -180,11 +180,16 @@ class ProgramRegistry:
         caching is untouched.
     max_programs : LRU bound on *built callables* (not markers); None =
         unbounded.
+    pinned : exempt this registry's callables from LRU eviction even
+        when ``max_programs`` is set.  The multi-model ``ModelPool``
+        pins a hot model's registry so its programs survive pressure
+        from sibling models; mutable at runtime (``registry.pinned``).
     """
 
     def __init__(self, cfg=None, dtype: str = "float32", plan=None,
                  cache_base: Optional[str] = None,
-                 max_programs: Optional[int] = None):
+                 max_programs: Optional[int] = None,
+                 pinned: bool = False):
         if dtype not in INFER_DTYPES:
             raise ValueError(f"dtype must be one of {INFER_DTYPES}, "
                              f"got {dtype!r}")
@@ -192,6 +197,7 @@ class ProgramRegistry:
         self.dtype = dtype
         self.sharding = plan_signature(plan)
         self.max_programs = max_programs
+        self.pinned = bool(pinned)
         self._lock = threading.Lock()
         self._builders: Dict[str, Callable[..., Callable]] = {}
         self._fns: "OrderedDict[Tuple[str, Tuple], Callable]" = OrderedDict()
@@ -393,7 +399,8 @@ class ProgramRegistry:
             # lost-race check: another thread may have built it meanwhile
             if ck not in self._fns:
                 self._fns[ck] = fn
-                while (self.max_programs is not None
+                while (not self.pinned
+                       and self.max_programs is not None
                        and len(self._fns) > self.max_programs):
                     evicted, _ = self._fns.popitem(last=False)
                     self.counters["evictions"] += 1
@@ -418,6 +425,7 @@ class ProgramRegistry:
                     for k, v in self._seen.items()]
         return {"digest": self.digest, "dtype": self.dtype,
                 "sharding": self.sharding, "cache_dir": self.cache_dir,
-                "owns_cache": self.owns_cache, "counters": counters,
+                "owns_cache": self.owns_cache, "pinned": self.pinned,
+                "counters": counters,
                 "programs": seen,
                 "compile_seconds": self.compile_hist.to_dict()}

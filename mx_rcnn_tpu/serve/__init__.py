@@ -25,6 +25,12 @@ jitted bucket programs, and the shared ``ops/postprocess`` block that
   temporal coalescing (same-bucket frames from different streams share
   one ``serve_e2e`` dispatch), and an on-device ``frame_delta`` skip
   gate that answers low-motion frames from cache without any forward.
+* ``pool``       — multi-model serving: N ``(config, params, Predictor)``
+  entries behind one frontend (``/predict?model=...``), a single
+  cross-model dispatcher interleaving per-model bucket queues by queue
+  depth × SLO class, and a device weight-residency manager paging param
+  trees host↔device under a byte budget (LRU, pinning, zero recompiles
+  — params are runtime arguments to every program).
 * ``fabric``     — the cross-host generalization: a transport-agnostic
   replica pool (local fork children + remote TCP members that ``--join``
   or are registered by address), HTTP-probe-driven membership with
@@ -53,6 +59,7 @@ from mx_rcnn_tpu.serve.frontend import (address_request, address_request_raw,
                                         tcp_http_request, tcp_http_request_raw,
                                         unix_http_request,
                                         unix_http_request_raw)
+from mx_rcnn_tpu.serve.pool import ModelEntry, ModelPool, param_nbytes
 from mx_rcnn_tpu.serve.replica import (CheckpointWatcher, NetFaults,
                                        ReplicaFaults, make_reloader,
                                        reload_engine_params,
@@ -79,4 +86,5 @@ __all__ = ["ServeEngine", "ServeOptions", "ServeFuture", "RejectedError",
            "parse_address", "address_request", "address_request_raw",
            "tcp_http_request", "tcp_http_request_raw",
            "StreamManager", "StreamOptions", "StaleSeqError",
-           "FrameResult", "run_stream_stdio"]
+           "FrameResult", "run_stream_stdio",
+           "ModelPool", "ModelEntry", "param_nbytes"]

@@ -72,6 +72,11 @@ class ControllerOptions:
     shed_margin: float = 1.5
     # how many ticks of depth history feed the trend slope
     trend_ticks: int = 8
+    # tenant/model label (multi-model serving: one controller per model;
+    # "" = the classic unlabeled single-model controller).  Shows up in
+    # log lines, state(), and the slo_decision meta so a shed can be
+    # attributed to the tenant whose traffic triggered it
+    label: str = ""
 
     def __post_init__(self):
         if self.target_p99_ms <= 0:
@@ -121,8 +126,10 @@ class SLOController:
         self._thread = threading.Thread(target=self._run,
                                         name="slo-controller", daemon=True)
         self._thread.start()
-        logger.info("SLO controller on: target p99 %.1f ms, tick %.0f ms, "
-                    "window %.1f s", self.opts.target_p99_ms,
+        logger.info("SLO controller%s on: target p99 %.1f ms, tick "
+                    "%.0f ms, window %.1f s",
+                    f" [{self.opts.label}]" if self.opts.label else "",
+                    self.opts.target_p99_ms,
                     self.opts.interval_s * 1e3, self.opts.window_s)
         return self
 
@@ -234,8 +241,9 @@ class SLOController:
                                 slope=slope, drain_s=drain_s,
                                 admit_limit=limit)
                 logger.warning(
-                    "SLO shed ON: depth %d growing (%.2f/s), drain %.2fs "
-                    "> %.2fs budget — admissions capped at %d", depth,
+                    "SLO shed ON%s: depth %d growing (%.2f/s), drain "
+                    "%.2fs > %.2fs budget — admissions capped at %d",
+                    f" [{o.label}]" if o.label else "", depth,
                     slope, drain_s, o.shed_margin * target_s, limit)
         elif self._shedding and healthy and slope <= 0:
             self._shedding = False
@@ -261,7 +269,7 @@ class SLOController:
                   self._admit_limit if self._admit_limit is not None else -1)
         for action, key, b, d in acted:
             tel.counter("slo/decisions")
-            tel.meta("slo_decision", action=action,
+            tel.meta("slo_decision", action=action, tenant=o.label or None,
                      bucket=None if key is None else f"{key[0]}x{key[1]}",
                      max_batch=b, max_delay_ms=d, p99_ms=p99_ms,
                      depth=depth, slope=round(slope, 4))
@@ -292,6 +300,7 @@ class SLOController:
         Prometheus registry)."""
         with self._lock:
             return {
+                "label": self.opts.label,
                 "target_p99_ms": self.opts.target_p99_ms,
                 "ticks": self.ticks,
                 "decisions": self.decisions,

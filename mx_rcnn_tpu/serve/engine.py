@@ -208,6 +208,15 @@ class ServeEngine:
         self._cond = threading.Condition(self._lock)
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        # external-dispatch mode (multi-model ModelPool): the engine owns
+        # queues, policy, and forwards, but a POOL dispatcher thread calls
+        # poll()/dispatch_batch() instead of the engine spawning its own
+        # loop — one device owner interleaving several models' buckets
+        self._external = False
+        # optional work signal for that pool dispatcher: called (with no
+        # lock ordering guarantees) whenever new work or a policy change
+        # may have made a flush due
+        self.on_work = None
         # readiness (distinct from liveness): set by warmup() once every
         # (bucket, batch) program is registered — /readyz gates routing on
         # it while /healthz only proves the process answers
@@ -281,8 +290,13 @@ class ServeEngine:
 
     # -- lifecycle -------------------------------------------------------
 
-    def start(self) -> "ServeEngine":
-        assert self._thread is None, "engine already started"
+    def start(self, external: bool = False) -> "ServeEngine":
+        """Spawn the dispatcher thread — or, with ``external=True``
+        (multi-model pool mode), skip it: the engine is fully live for
+        submits/policy/metrics but batches only flush when an external
+        dispatcher calls :meth:`poll` + :meth:`dispatch_batch`."""
+        assert self._thread is None and not self._external, \
+            "engine already started"
         if self.opts.prep_workers > 0 and self._pool is None:
             from mx_rcnn_tpu.data.workers import WorkerPool
 
@@ -290,6 +304,9 @@ class ServeEngine:
             # prepared bucket arrays come back through the shm ring
             self._pool = WorkerPool(self.cfg,
                                     num_workers=self.opts.prep_workers)
+        if external:
+            self._external = True
+            return self
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="serve-dispatch", daemon=True)
         self._thread.start()
@@ -310,6 +327,9 @@ class ServeEngine:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+        self._external = False
+        if self.on_work is not None:
+            self.on_work()
         if self.capture.enabled:
             self.capture.close()
 
@@ -324,7 +344,8 @@ class ServeEngine:
     def is_ready(self) -> bool:
         with self._lock:
             return (self._ready.is_set() and not self._draining
-                    and not self._stop and self._thread is not None)
+                    and not self._stop
+                    and (self._thread is not None or self._external))
 
     def readiness(self) -> dict:
         """The ``/readyz`` payload — warmup + admission state, distinct
@@ -333,7 +354,8 @@ class ServeEngine:
         with self._lock:
             return {
                 "ready": (self._ready.is_set() and not self._draining
-                          and not self._stop and self._thread is not None),
+                          and not self._stop
+                          and (self._thread is not None or self._external)),
                 "warmed": self._ready.is_set(),
                 "draining": self._draining,
                 "generation": self.generation,
@@ -362,6 +384,8 @@ class ServeEngine:
         with self._cond:
             self._draining = False
             self._cond.notify_all()
+        if self.on_work is not None:
+            self.on_work()
 
     # -- intake ----------------------------------------------------------
 
@@ -409,6 +433,8 @@ class ServeEngine:
                     self._bucket_delay_ms[key] = d
             # a shorter delay may make a parked bucket due immediately
             self._cond.notify()
+        if self.on_work is not None:
+            self.on_work()
 
     def set_admit_limit(self, limit: Optional[int]):
         """Shed submits (503) at this queue depth — the controller's
@@ -547,6 +573,8 @@ class ServeEngine:
             tel.counter("serve/requests")
             tel.gauge("serve/queue_depth", depth + 1)
             self._cond.notify()
+        if self.on_work is not None:
+            self.on_work()
         return req.future
 
     def predict(self, image: np.ndarray,
@@ -601,6 +629,83 @@ class ServeEngine:
             return take, None
         return None, wait
 
+    def _fail_expired(self, expired: List[_Request]):
+        for r in expired:
+            self.counters["deadline_exceeded"] += 1
+            telemetry.get().counter("serve/deadline_exceeded")
+            r.future._set_error(DeadlineExceededError(
+                "request expired before it reached a batch (engine "
+                "overloaded? raise --max-queue workers or add "
+                "replicas)"))
+
+    # -- external (pool) dispatch surface --------------------------------
+
+    def due_state(self, now: float):
+        """Lock-held peek for the ModelPool scheduler: ``(due, depth,
+        wait_s)``.  ``due`` is True when a bucket would flush right now
+        (full, delay elapsed) OR an expired request needs sweeping;
+        ``wait_s`` is the earliest instant that could change (None when
+        idle).  Purely advisory — :meth:`poll` re-judges under the lock,
+        so a racing submit is at worst a missed wakeup until on_work."""
+        with self._lock:
+            depth = 0
+            due = False
+            wait = None
+            for key, q in self._queues.items():
+                depth += len(q)
+                if not q or due:
+                    continue
+                B = self._bucket_batch.get(key, self.opts.batch_size)
+                delay = self._bucket_delay_ms.get(
+                    key, self.opts.max_delay_ms) / 1e3
+                head_t = q[0].t_enqueue
+                if len(q) >= B or (now - head_t) >= delay:
+                    due = True
+                    continue
+                remaining = delay - (now - head_t)
+                wait = remaining if wait is None else min(wait, remaining)
+                for r in q:
+                    if r.deadline is not None:
+                        if r.deadline <= now:
+                            due = True
+                            break
+                        wait = min(wait, r.deadline - now)
+        return due, depth, wait
+
+    def poll(self, now: Optional[float] = None):
+        """Claim the next due batch for an external dispatcher: sweeps
+        expired requests (failing them with 504) and pops one bucket's
+        flush if due.  Returns ``(batch, wait_s)`` — a claimed batch
+        holds an inflight slot until :meth:`dispatch_batch` releases it;
+        ``(None, wait_s)`` means nothing is due for ``wait_s`` seconds
+        (None = idle/stopped)."""
+        with self._cond:
+            if self._stop:
+                return None, None
+            if now is None:
+                now = time.monotonic()
+            expired = self._sweep_expired_locked(now)
+            batch, wait = self._next_batch_locked(now)
+            if batch is not None:
+                self._inflight += 1
+        self._fail_expired(expired)
+        return batch, wait
+
+    def dispatch_batch(self, batch: List[_Request]):
+        """Run one batch claimed by :meth:`poll` (external dispatcher's
+        half of ``_dispatch_loop``): forwards, fails the batch on error,
+        and releases the inflight slot either way."""
+        try:
+            self._run_batch(batch, time.monotonic())
+        except BaseException as e:  # noqa: BLE001 — fail the batch
+            logger.exception("serve batch failed")
+            for r in batch:
+                r.future._set_error(e)
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()  # drain() waits on this
+
     def _dispatch_loop(self):
         while True:
             with self._cond:
@@ -614,24 +719,9 @@ class ServeEngine:
                 if batch is None and not expired:
                     self._cond.wait(timeout=wait)
                     continue
-            for r in expired:
-                self.counters["deadline_exceeded"] += 1
-                telemetry.get().counter("serve/deadline_exceeded")
-                r.future._set_error(DeadlineExceededError(
-                    "request expired before it reached a batch (engine "
-                    "overloaded? raise --max-queue workers or add "
-                    "replicas)"))
+            self._fail_expired(expired)
             if batch is not None:
-                try:
-                    self._run_batch(batch, time.monotonic())
-                except BaseException as e:  # noqa: BLE001 — fail the batch
-                    logger.exception("serve batch failed")
-                    for r in batch:
-                        r.future._set_error(e)
-                finally:
-                    with self._cond:
-                        self._inflight -= 1
-                        self._cond.notify_all()  # drain() waits on this
+                self.dispatch_batch(batch)
 
     def _run_batch(self, reqs: List[_Request], now: float):
         import jax
@@ -833,7 +923,8 @@ class ServeEngine:
                 "admit_limit": self._admit_limit,
                 "generation": self.generation,
                 "ready": (self._ready.is_set() and not self._draining
-                          and not self._stop and self._thread is not None),
+                          and not self._stop
+                          and (self._thread is not None or self._external)),
                 "draining": self._draining,
             }
         latency = {}

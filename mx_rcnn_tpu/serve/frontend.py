@@ -67,7 +67,8 @@ from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.serve.engine import (DeadlineExceededError, RejectedError,
                                       ServeEngine)
 from mx_rcnn_tpu.serve.stream import StaleSeqError, StreamManager
-from mx_rcnn_tpu.telemetry.obs import PROM_CONTENT_TYPE, serve_prometheus
+from mx_rcnn_tpu.telemetry.obs import (PROM_CONTENT_TYPE, pool_prometheus,
+                                       serve_prometheus)
 
 # result-wait ceiling for one HTTP request; the engine's own per-request
 # deadline (default ServeOptions.deadline_ms) fires long before this —
@@ -212,14 +213,48 @@ def handle_stream_lines(stream: StreamManager, lines) -> list:
             for status, err, res in staged]
 
 
+def query_model(query: str) -> Optional[str]:
+    """Extract ``model=...`` from a raw query string (None if absent)."""
+    for part in query.split("&"):
+        k, _, v = part.partition("=")
+        if k == "model" and v:
+            return v
+    return None
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     engine: ServeEngine = None  # set by make_server subclassing
     stream: Optional[StreamManager] = None  # enables POST /stream
+    pool = None          # optional ModelPool: enables ?model=... routing
+    streams = None       # pool mode: {model_id: StreamManager}
     reloader = None      # optional callback(doc) -> (status, doc)
     request_hook = None  # optional callback(status) after each /predict
     gate = None          # optional callback() before any handling
     net_faults = None    # optional NetFaults: intercept(path, handler)
+
+    def _resolve_engine(self, query: str, doc: Optional[dict] = None):
+        """``?model=...`` (or a ``"model"`` field in the request doc) →
+        ``(engine, None)`` or ``(None, (status, error_doc))``.  Without a
+        pool, any explicit model selector is a 404 (multi-model routing
+        is opt-in via ``--models``); with one, the id resolves to that
+        model's own engine — its bucket set, programs, AOT subtree."""
+        mid = query_model(query) if query else None
+        if mid is None and doc is not None:
+            m = doc.get("model")
+            if isinstance(m, str) and m:
+                mid = m
+        if self.pool is None:
+            if mid is not None:
+                return None, (404, {"error": f"model routing not enabled "
+                                             f"(requested {mid!r}; start "
+                                             f"with --models)"})
+            return self.engine, None
+        try:
+            return self.pool.engine_for(mid), None
+        except KeyError as e:
+            return None, (404, {"error": str(e.args[0]) if e.args
+                                else str(e)})
 
     # -- plumbing --------------------------------------------------------
 
@@ -252,19 +287,31 @@ class _Handler(BaseHTTPRequestHandler):
             return
         path, _, query = self.path.partition("?")
         if path == "/healthz":
-            self._reply(200, {"status": "ok",
-                              "queue_depth": self.engine.queue_depth()})
+            if self.pool is not None:
+                self._reply(200, {"status": "ok",
+                                  "models": self.pool.model_ids(),
+                                  "queue_depth": sum(
+                                      self.pool.engine_for(m).queue_depth()
+                                      for m in self.pool.model_ids())})
+            else:
+                self._reply(200, {"status": "ok",
+                                  "queue_depth":
+                                      self.engine.queue_depth()})
         elif path == "/readyz":
-            doc = self.engine.readiness()
+            doc = (self.pool.readiness() if self.pool is not None
+                   else self.engine.readiness())
             self._reply(200 if doc["ready"] else 503, doc)
         elif path == "/metrics":
             # content negotiation: JSON stays the default for existing
             # callers; Prometheus scrapers ask via Accept or ?format=prom
             accept = self.headers.get("Accept", "")
             if "format=prom" in query or "text/plain" in accept:
-                self._reply_raw(200,
-                                serve_prometheus(self.engine).encode(),
-                                PROM_CONTENT_TYPE)
+                text = (pool_prometheus(self.pool)
+                        if self.pool is not None
+                        else serve_prometheus(self.engine))
+                self._reply_raw(200, text.encode(), PROM_CONTENT_TYPE)
+            elif self.pool is not None:
+                self._reply(200, self.pool.metrics())
             else:
                 self._reply(200, self.engine.metrics())
         else:
@@ -276,11 +323,25 @@ class _Handler(BaseHTTPRequestHandler):
         if self.net_faults is not None and \
                 self.net_faults.intercept(self.path, self):
             return
-        if self.path not in ("/predict", "/admin/reload", "/stream"):
+        # query split mirrors do_GET: /predict?model=... must route, and
+        # a bare single-model boot keeps 404-ing unknown query'd paths
+        # through the explicit model-routing error below
+        path, _, query = self.path.partition("?")
+        if path not in ("/predict", "/admin/reload", "/stream"):
             self._reply(404, {"error": f"no route {self.path}"})
             return
-        if self.path == "/stream":
-            if self.stream is None:
+        if path == "/stream":
+            # pool mode: ?model=... picks that model's StreamManager (the
+            # /predict routing twin); frames inside one body share it
+            stream = self.stream
+            if self.pool is not None:
+                mid = query_model(query) or self.pool.default_model
+                stream = (self.streams or {}).get(mid)
+                if stream is None and mid not in self.pool.model_ids():
+                    self._reply(404, {"error": f"unknown model {mid!r} "
+                                      f"(have {self.pool.model_ids()})"})
+                    return
+            if stream is None:
                 self._reply(404, {"error": "streaming not enabled "
                                            "(start with --stream)"})
                 return
@@ -291,7 +352,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, {"error": f"bad Content-Length: {e}"})
                 return
             replies = handle_stream_lines(
-                self.stream, body.decode("utf-8", "replace").splitlines())
+                stream, body.decode("utf-8", "replace").splitlines())
             payload = "".join(json.dumps({"status": s, **d}) + "\n"
                               for s, d in replies)
             self._reply_raw(200, payload.encode(), "application/x-ndjson")
@@ -302,13 +363,19 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": f"bad JSON body: {e}"})
             return
-        if self.path == "/admin/reload":
+        if path == "/admin/reload":
             if self.reloader is None:
                 self._reply(404, {"error": "no reloader configured"})
                 return
             self._reply(*self.reloader(doc))
             return
-        status, resp = handle_request_doc(self.engine, doc)
+        engine, err = self._resolve_engine(query, doc)
+        if engine is None:
+            self._reply(*err)
+            if self.request_hook is not None:
+                self.request_hook(err[0])
+            return
+        status, resp = handle_request_doc(engine, doc)
         self._reply(status, resp)
         if self.request_hook is not None:
             self.request_hook(status)
@@ -338,7 +405,8 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
                 host: str = "127.0.0.1",
                 unix_socket: Optional[str] = None,
                 reloader=None, request_hook=None, gate=None,
-                net_faults=None, stream: Optional[StreamManager] = None):
+                net_faults=None, stream: Optional[StreamManager] = None,
+                pool=None, streams: Optional[dict] = None):
     """Build (not start) the HTTP server — exactly one of ``port`` /
     ``unix_socket``.  Caller owns ``serve_forever``/``shutdown``.
 
@@ -348,7 +416,14 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
     kill-after-N / hang injection points.  ``net_faults`` (an object
     with ``intercept(path, handler) -> bool``) sits below both and can
     blackhole, delay, or reset the connection — the fabric chaos
-    harness's network-layer injection point."""
+    harness's network-layer injection point.
+
+    ``pool`` (a :class:`~mx_rcnn_tpu.serve.pool.ModelPool`) turns on
+    multi-model routing: ``?model=...`` on ``/predict``/``/stream``
+    resolves to that model's engine / StreamManager (``streams``:
+    model_id → manager), ``/metrics`` reports the whole fleet, and
+    ``/readyz`` requires every model warm.  ``engine`` stays the default
+    model's engine so single-model callers are untouched."""
     if (port is None) == (unix_socket is None):
         raise ValueError("pass exactly one of port / unix_socket")
 
@@ -357,6 +432,8 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
 
     Handler.engine = engine
     Handler.stream = stream  # a StreamManager enables POST /stream
+    Handler.pool = pool
+    Handler.streams = streams
     # staticmethod: a plain function stored on the class would otherwise
     # bind as a method and receive the handler as a bogus first argument
     Handler.reloader = staticmethod(reloader) if reloader else None

@@ -1,0 +1,420 @@
+"""Multi-model serving plane: one device, a fleet of models.
+
+``serve.py`` historically bound one process to one ``(config, params,
+Predictor)``.  :class:`ModelPool` lifts that to N models behind a single
+frontend without N× device memory, N× recompiles, or one tenant's burst
+destroying another's p99:
+
+* **Model registry.**  Each entry keys a model id to its own config,
+  ``Predictor`` (hence its own ``ProgramRegistry`` — program identity
+  already folds the config digest, so models get disjoint program keys
+  and AOT cache subtrees for free) and its own :class:`ServeEngine`
+  started in external-dispatch mode.  ``/predict?model=...`` resolves
+  here; requests without a model land on the default entry, preserving
+  single-model semantics byte-for-byte.
+* **Device weight residency.**  Param trees are paged host↔device under
+  a configurable byte budget (``--weight-budget-mb``) with LRU eviction
+  over last-dispatch time.  A page-out snapshots the variant-cast tree
+  to host memory and deletes the device buffers; a page-in is a plain
+  ``device_put`` of that snapshot — params are RUNTIME arguments to
+  every registered program (the ``update_params`` hot-reload contract),
+  so paging costs zero recompiles.  Pinned models are never paged out
+  (their registries are also exempt from program LRU eviction).
+  Counters ``serve/weight_page_in|out`` + per-model residency gauges
+  make the paging observable on ``/metrics``.
+* **Cross-model batch scheduling.**  ONE pool dispatcher thread owns
+  the device and interleaves per-model bucket queues: among models with
+  a due flush it picks the highest ``weight * (queue_depth + 1)`` score
+  (weight = the model's SLO class), tie-broken by least-recently
+  scheduled, so heterogeneous traffic keeps dispatch occupancy high and
+  a cheap model is not starved by a heavy one.  Within a model the
+  engine's own full-beats-oldest-partial bucket ordering is unchanged.
+* **Tenant isolation.**  Each entry can carry its own
+  :class:`~mx_rcnn_tpu.serve.controller.SLOController` (distinct
+  ``--target-p99-ms``): admission shedding and flush-policy adaptation
+  act on that model's engine only, so a burst on the mask model sheds
+  the mask model's traffic first.
+
+Driver: ``serve.py --models a=resnet50,b=vgg16`` (per-model overrides
+via ``--model-arg``); loadgen: ``scripts/loadgen.py --models
+a=0.7,b=0.3``; smoke: ``script/multimodel_smoke.sh``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.logger import logger
+
+
+def param_nbytes(tree) -> int:
+    """Total bytes of a param tree's leaves (device or host)."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+    except Exception:
+        return 0
+    total = 0
+    for leaf in leaves:
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            size = getattr(leaf, "size", 0)
+            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 0)
+            nbytes = size * itemsize
+        total += int(nbytes)
+    return total
+
+
+class ModelEntry:
+    """One registered model: identity, compute, policy, residency."""
+
+    __slots__ = ("model_id", "cfg", "predictor", "engine", "controller",
+                 "pinned", "weight", "resident", "bytes", "host_params",
+                 "last_use", "last_sched", "batches", "page_ins",
+                 "page_outs")
+
+    def __init__(self, model_id, cfg, predictor, engine, controller=None,
+                 pinned=False, weight=1.0):
+        self.model_id = model_id
+        self.cfg = cfg
+        self.predictor = predictor
+        self.engine = engine
+        self.controller = controller
+        self.pinned = bool(pinned)
+        self.weight = max(float(weight), 1e-3)
+        self.resident = True        # params arrive placed by construction
+        self.bytes = param_nbytes(getattr(predictor, "params", None))
+        self.host_params = None     # host snapshot while paged out
+        self.last_use = time.monotonic()
+        self.last_sched = 0.0
+        self.batches = 0
+        self.page_ins = 0
+        self.page_outs = 0
+
+
+class ModelPool:
+    """Owns the model entries, the weight-residency manager, and the one
+    cross-model dispatcher thread.  Engines must be started with
+    ``start(external=True)`` before :meth:`add_model`."""
+
+    def __init__(self, budget_bytes: int = 0, idle_poll_s: float = 0.05):
+        # 0 = unbounded (no paging ever happens except explicit calls)
+        self.budget_bytes = max(int(budget_bytes), 0)
+        self._idle_poll_s = max(float(idle_poll_s), 1e-3)
+        self._entries: "Dict[str, ModelEntry]" = {}
+        self._order: List[str] = []     # registration order; [0] = default
+        self._lock = threading.RLock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._last_model: Optional[str] = None
+        self.counters = {"weight_page_in": 0, "weight_page_out": 0,
+                         "sched_batches": 0, "sched_switches": 0}
+
+    # -- registry --------------------------------------------------------
+
+    def add_model(self, model_id: str, cfg, predictor, engine,
+                  controller=None, pinned: bool = False,
+                  weight: float = 1.0) -> ModelEntry:
+        if not model_id or "/" in model_id:
+            raise ValueError(f"bad model id {model_id!r}")
+        entry = ModelEntry(model_id, cfg, predictor, engine,
+                           controller=controller, pinned=pinned,
+                           weight=weight)
+        with self._lock:
+            if model_id in self._entries:
+                raise ValueError(f"model {model_id!r} already registered")
+            if pinned:
+                pinned_total = entry.bytes + sum(
+                    e.bytes for e in self._entries.values() if e.pinned)
+                if self.budget_bytes and pinned_total > self.budget_bytes:
+                    raise ValueError(
+                        f"pinned models need {pinned_total} bytes, over "
+                        f"the {self.budget_bytes}-byte weight budget")
+                reg = getattr(predictor, "registry", None)
+                if reg is not None:
+                    reg.pinned = True
+            self._entries[model_id] = entry
+            self._order.append(model_id)
+        engine.on_work = self._wake.set
+        # a new resident model may push the pool over budget: evict
+        # colder models rather than refusing the registration
+        self.ensure_resident(model_id)
+        logger.info("model pool: registered %r (%d bytes, pinned=%s, "
+                    "weight=%g)", model_id, entry.bytes, pinned, weight)
+        return entry
+
+    def model_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._order)
+
+    @property
+    def default_model(self) -> Optional[str]:
+        with self._lock:
+            return self._order[0] if self._order else None
+
+    def entry(self, model_id: Optional[str] = None) -> ModelEntry:
+        """Resolve a model id (None = default) to its entry; raises
+        ``KeyError`` for unknown ids — the frontend's 404."""
+        with self._lock:
+            if model_id is None:
+                if not self._order:
+                    raise KeyError("model pool is empty")
+                model_id = self._order[0]
+            e = self._entries.get(model_id)
+            if e is None:
+                raise KeyError(f"unknown model {model_id!r} "
+                               f"(have {sorted(self._entries)})")
+            return e
+
+    def engine_for(self, model_id: Optional[str] = None):
+        return self.entry(model_id).engine
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "ModelPool":
+        if self._thread is not None:
+            return self
+        self._stop = False
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        name="pool-dispatch", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            if e.controller is not None:
+                try:
+                    e.controller.stop()
+                except Exception:
+                    pass
+            e.engine.stop(timeout=timeout)
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def is_ready(self) -> bool:
+        with self._lock:
+            entries = list(self._entries.values())
+        return bool(entries) and all(e.engine.is_ready() for e in entries)
+
+    def readiness(self) -> dict:
+        with self._lock:
+            entries = [(mid, self._entries[mid]) for mid in self._order]
+        per_model = {mid: e.engine.readiness() for mid, e in entries}
+        return {"ready": bool(per_model)
+                and all(d["ready"] for d in per_model.values()),
+                "models": per_model}
+
+    # -- weight residency ------------------------------------------------
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(e.bytes for e in self._entries.values()
+                       if e.resident)
+
+    def ensure_resident(self, model_id: str) -> None:
+        """Make ``model_id``'s params device-resident, paging out LRU
+        non-pinned siblings as needed to respect the byte budget.  Called
+        by the dispatcher before every batch; cheap no-op when already
+        resident (the steady state)."""
+        with self._lock:
+            e = self._entries[model_id]
+            e.last_use = time.monotonic()
+            if e.resident:
+                self._evict_over_budget_locked(keep=model_id)
+                return
+            need = e.bytes
+            if self.budget_bytes:
+                self._evict_over_budget_locked(keep=model_id, incoming=need)
+            self._page_in_locked(e)
+
+    def _evict_over_budget_locked(self, keep: str, incoming: int = 0):
+        if not self.budget_bytes:
+            return
+        resident = sum(e.bytes for e in self._entries.values()
+                       if e.resident)
+        over = resident + incoming - self.budget_bytes
+        if over <= 0:
+            return
+        victims = sorted(
+            (e for e in self._entries.values()
+             if e.resident and not e.pinned and e.model_id != keep),
+            key=lambda e: e.last_use)
+        for v in victims:
+            if over <= 0:
+                break
+            self._page_out_locked(v)
+            over -= v.bytes
+        if over > 0:
+            # pinned + the incoming model alone exceed the budget; serve
+            # anyway (refusing would deadlock traffic) but say so loudly
+            logger.warning("model pool: weight budget %d bytes exceeded "
+                           "by %d bytes even after paging (pinned set too "
+                           "large?)", self.budget_bytes, over)
+
+    def _page_out_locked(self, e: ModelEntry):
+        import numpy as np
+
+        params = getattr(e.predictor, "params", None)
+        if params is None:
+            e.resident = False
+            return
+        try:
+            import jax
+
+            host = jax.tree_util.tree_map(
+                lambda x: np.array(x, copy=True), jax.device_get(params))
+            for leaf in jax.tree_util.tree_leaves(params):
+                delete = getattr(leaf, "delete", None)
+                if delete is not None:
+                    try:
+                        delete()
+                    except Exception:
+                        pass
+        except Exception:
+            host = params  # duck-typed predictor: host tree already
+        # host tree stays bound: an unscheduled dispatch would still be
+        # CORRECT (jax transfers arguments), just unaccounted — the
+        # dispatcher's ensure_resident keeps the hot path paged in
+        e.predictor.params = host
+        e.host_params = host
+        e.resident = False
+        e.page_outs += 1
+        self.counters["weight_page_out"] += 1
+        telemetry.get().counter("serve/weight_page_out")
+        logger.info("model pool: paged OUT %r (%d bytes)", e.model_id,
+                    e.bytes)
+
+    def _page_in_locked(self, e: ModelEntry):
+        host = e.host_params if e.host_params is not None \
+            else getattr(e.predictor, "params", None)
+        if host is not None:
+            try:
+                import jax
+
+                plan = getattr(e.predictor, "plan", None)
+                placed = (jax.device_put(host, plan.replicated())
+                          if plan is not None else jax.device_put(host))
+            except Exception:
+                placed = host  # duck-typed predictor
+            e.predictor.params = placed
+            e.bytes = param_nbytes(placed) or e.bytes
+        e.host_params = None
+        e.resident = True
+        e.page_ins += 1
+        self.counters["weight_page_in"] += 1
+        telemetry.get().counter("serve/weight_page_in")
+        logger.info("model pool: paged IN %r (%d bytes)", e.model_id,
+                    e.bytes)
+
+    def residency(self) -> dict:
+        """The /metrics residency doc: budget, live device bytes, and a
+        per-model gauge block (also mirrored into the telemetry sink as
+        ``serve/resident_bytes`` + ``serve/resident/<model>``)."""
+        now = time.monotonic()
+        with self._lock:
+            models = {
+                e.model_id: {"resident": int(e.resident),
+                             "bytes": e.bytes,
+                             "pinned": e.pinned,
+                             "weight": e.weight,
+                             "page_ins": e.page_ins,
+                             "page_outs": e.page_outs,
+                             "idle_s": round(now - e.last_use, 3)}
+                for e in self._entries.values()}
+            device_bytes = sum(e.bytes for e in self._entries.values()
+                               if e.resident)
+        tel = telemetry.get()
+        tel.gauge("serve/resident_bytes", device_bytes)
+        for mid, doc in models.items():
+            tel.gauge(f"serve/resident/{mid}", doc["resident"])
+        return {"budget_bytes": self.budget_bytes,
+                "device_bytes": device_bytes,
+                "resident_models": sum(d["resident"]
+                                       for d in models.values()),
+                "models": models}
+
+    # -- cross-model dispatch --------------------------------------------
+
+    def _pick_locked(self, now: float):
+        """(entry, wait_s): the due model with the best
+        ``weight * (depth + 1)`` score (least-recently-scheduled breaks
+        ties), or (None, soonest-deadline) when nothing is due."""
+        best = None
+        best_score = None
+        wait = None
+        for mid in self._order:
+            e = self._entries[mid]
+            due, depth, w = e.engine.due_state(now)
+            if due:
+                score = (e.weight * (depth + 1), -e.last_sched)
+                if best is None or score > best_score:
+                    best, best_score = e, score
+            elif w is not None:
+                wait = w if wait is None else min(wait, w)
+        return best, wait
+
+    def _dispatch_loop(self):
+        while not self._stop:
+            now = time.monotonic()
+            with self._lock:
+                e, wait = self._pick_locked(now)
+            if e is None:
+                timeout = self._idle_poll_s if wait is None \
+                    else max(min(wait, self._idle_poll_s), 1e-4)
+                self._wake.wait(timeout=timeout)
+                self._wake.clear()
+                continue
+            batch, _ = e.engine.poll(now)
+            if batch is None:
+                # raced with a sweep/policy change; re-judge immediately
+                continue
+            self.ensure_resident(e.model_id)
+            with self._lock:
+                if self._last_model not in (None, e.model_id):
+                    self.counters["sched_switches"] += 1
+                self._last_model = e.model_id
+                e.last_sched = now
+                e.batches += 1
+                self.counters["sched_batches"] += 1
+            e.engine.dispatch_batch(batch)
+
+    # -- introspection ---------------------------------------------------
+
+    def metrics(self) -> dict:
+        """The pool-mode ``/metrics`` payload.  Top-level ``counters``
+        aggregates every model's engine counters (so single-model
+        clients — loadgen's server-counter deltas — keep working), with
+        the full per-model picture under ``models`` and the pool's own
+        scheduling + residency state alongside."""
+        with self._lock:
+            order = list(self._order)
+            pool_counters = dict(self.counters)
+            batches = {mid: self._entries[mid].batches for mid in order}
+        models = {mid: self.engine_for(mid).metrics() for mid in order}
+        agg: Dict[str, float] = {}
+        for doc in models.values():
+            for k, v in (doc.get("counters") or {}).items():
+                if isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+        return {"multimodel": True,
+                "default_model": order[0] if order else None,
+                "models": models,
+                "counters": agg,
+                "queue_depth": sum(d.get("queue_depth", 0)
+                                   for d in models.values()),
+                "ready": bool(models) and all(d.get("ready")
+                                              for d in models.values()),
+                "pool": {"counters": pool_counters,
+                         "batches": batches,
+                         "last_model": self._last_model},
+                "residency": self.residency()}

@@ -43,7 +43,10 @@ def warmup(engine) -> int:
     into the ``serve/warmup_compile_s`` gauge, and — when the engine's
     predictor carries a :class:`~mx_rcnn_tpu.compile.ProgramRegistry` —
     logs the AOT hit/miss split for the warmed programs."""
-    assert engine._thread is not None, "start() the engine before warmup"
+    # pool-mode engines have no thread of their own: the ModelPool
+    # dispatcher flushes them, so warmup only needs SOME dispatcher live
+    assert engine._thread is not None or engine._external, \
+        "start() the engine before warmup"
     short, long_ = engine._scale
     t0 = time.perf_counter()
     reg = getattr(engine, "registry", None)

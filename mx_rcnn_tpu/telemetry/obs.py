@@ -447,3 +447,40 @@ def serve_prometheus(engine) -> str:
     registry as the obs server (one metrics path, not two)."""
     rank = telemetry.get().rank
     return prometheus_text({rank: engine_summary(engine)})
+
+
+def pool_summary(pool) -> dict:
+    """Summary-shaped dict for the ModelPool's own state: paging and
+    cross-model scheduling counters plus residency gauges — the block
+    ``/metrics?format=prom`` renders under the synthetic ``pool`` rank
+    alongside each model's per-rank engine summary."""
+    res = pool.residency()
+    counters = {"serve/weight_page_in": pool.counters["weight_page_in"],
+                "serve/weight_page_out": pool.counters["weight_page_out"],
+                "serve/sched_batches": pool.counters["sched_batches"],
+                "serve/sched_switches": pool.counters["sched_switches"]}
+
+    def point(v):
+        return {"count": 1, "mean": v, "min": v, "max": v, "last": v}
+
+    gauges = {"serve/weight_budget_bytes": point(res["budget_bytes"]),
+              "serve/resident_bytes": point(res["device_bytes"]),
+              "serve/resident_models": point(res["resident_models"])}
+    for mid, doc in res["models"].items():
+        gauges[f"serve/resident/{mid}"] = point(doc["resident"])
+        gauges[f"serve/weight_bytes/{mid}"] = point(doc["bytes"])
+        counters[f"serve/weight_page_in/{mid}"] = doc["page_ins"]
+        counters[f"serve/weight_page_out/{mid}"] = doc["page_outs"]
+    return {"spans": {}, "counters": counters, "gauges": gauges,
+            "hists": {}}
+
+
+def pool_prometheus(pool) -> str:
+    """Multi-model ``/metrics?format=prom``: one rank per MODEL ID (each
+    model's engine summary renders under ``rank="<model>"``) plus the
+    pool's paging/scheduling block under ``rank="pool"`` — per-model
+    families without inventing a second label scheme."""
+    per_rank = {mid: engine_summary(pool.engine_for(mid))
+                for mid in pool.model_ids()}
+    per_rank["pool"] = pool_summary(pool)
+    return prometheus_text(per_rank)

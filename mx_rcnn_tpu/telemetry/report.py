@@ -87,6 +87,20 @@ FLYWHEEL_COUNTERS = (
     "flywheel/train_failed",
 )
 
+# the multi-model pool's paging + cross-model scheduling health
+# (serve/pool.py): rendered as their own section — zeros included —
+# whenever the stream carries any of these, so "did weights page under
+# the budget, and did the scheduler actually interleave tenants?" is
+# one greppable block (script/multimodel_smoke.sh reads it); the
+# per-model variants (serve/weight_page_in/<model>, ...) render inside
+# the same section
+POOL_COUNTERS = (
+    "serve/weight_page_in",
+    "serve/weight_page_out",
+    "serve/sched_batches",
+    "serve/sched_switches",
+)
+
 # streaming serving's temporal-reuse progress (serve/stream.py + the
 # engine's stream-aware flush bookkeeping): rendered as their own
 # section — zeros included — whenever the stream carries any stream/*
@@ -252,6 +266,12 @@ def render_table(summary: dict) -> str:
         k.startswith("flywheel/") for k in summary.get("gauges", {}))
     streaming = any(k.startswith("stream/") for k in counters) or any(
         k.startswith("stream/") for k in summary.get("gauges", {}))
+    pool = any(k in POOL_COUNTERS or k.startswith("serve/weight_page")
+               or k.startswith("serve/sched_") for k in counters)
+    pool_extra = sorted(
+        n for n in counters if n not in POOL_COUNTERS
+        and (n.startswith("serve/weight_page_in/")
+             or n.startswith("serve/weight_page_out/")))
     if counters:
         lines.append("")
         lines.append(f"{'counter':<34}{'total':>8}")
@@ -272,6 +292,8 @@ def render_table(summary: dict) -> str:
                 continue  # ditto the flywheel table
             if streaming and name in STREAM_COUNTERS:
                 continue  # ditto the streaming table
+            if pool and (name in POOL_COUNTERS or name in pool_extra):
+                continue  # ditto the model-pool table
             lines.append(f"{name:<34}{v:>8}")
         lines.append("")
         lines.append(f"{'recovery event':<34}{'total':>8}")
@@ -298,6 +320,13 @@ def render_table(summary: dict) -> str:
             lines.append("")
             lines.append(f"{'streaming':<34}{'total':>8}")
             for name in STREAM_COUNTERS:
+                lines.append(f"{name:<34}{counters.get(name, 0):>8}")
+        if pool:
+            lines.append("")
+            lines.append(f"{'model pool':<34}{'total':>8}")
+            for name in POOL_COUNTERS:
+                lines.append(f"{name:<34}{counters.get(name, 0):>8}")
+            for name in pool_extra:  # per-model paging counters
                 lines.append(f"{name:<34}{counters.get(name, 0):>8}")
     gauges = summary.get("gauges", {})
     if gauges:

@@ -1,0 +1,163 @@
+#!/usr/bin/env bash
+# Multi-model serving smoke (CPU-friendly), asserting the --models
+# contract end to end on real servers:
+#   1. SINGLE-MODEL baseline boot (cold --program-cache): steady loadgen
+#      records the single-model imgs/sec the pool is gated against.
+#   2. POOL boot (--models a=...,b=... — same network, a digest-changing
+#      per-model config override, so the models have disjoint program
+#      keys and AOT subtrees): mixed loadgen --models a=0.7,b=0.3 with
+#      --assert-2xx (the burst-on-one-model profile included) writes
+#      MULTIMODEL_r01.json — aggregate throughput floored at half the
+#      single-model baseline, sibling p99 ceilinged while model a
+#      bursts.  /metrics must show zero steady-state recompiles PER
+#      MODEL (recompiles == warmup_programs for each), live residency
+#      gauges for both models, and a pool scheduler that actually
+#      interleaved (sched_batches > 0).
+#   3. WARM pool boot over the now-populated cache: the ISSUE-15
+#      acceptance — aot_hit summed across ALL models equals
+#      warmup_programs summed across all models (every program of every
+#      model loads from the persistent cache; the second boot compiles
+#      nothing).
+#   4. scripts/perf_gate.py gates the trajectory including the new
+#      MULTIMODEL rows (aggregate-throughput floor, isolation ceiling).
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${MULTIMODEL_SMOKE_DIR:-/tmp/mxr_multimodel_smoke}
+deadline_ms=60000
+rm -rf "$dir"
+mkdir -p "$dir"
+cache="$dir/program_cache"
+tinycfg=(--cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)"
+         --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32)
+# model b = same network, one digest-changing override: disjoint program
+# keys + AOT subtree (the realistic two-deployments-one-chip shape)
+mmflags=(--models a=resnet50,b=resnet50 --model-arg "b:cfg=TEST__NMS=0.31"
+         --model-arg a:weight=2)
+
+wait_healthy() {
+  python - "$1" "$2" <<'EOF'
+import os, sys, time
+from mx_rcnn_tpu.serve import unix_http_request
+sock, pid = sys.argv[1], int(sys.argv[2])
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("serve.py exited before becoming healthy")
+    try:
+        status, doc = unix_http_request(sock, "GET", "/healthz", timeout=5)
+        if status == 200:
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1)
+sys.exit("serve.py never became healthy")
+EOF
+}
+
+stop() {  # pid — TERM and poll until gone (the server is a subshell
+  # child, so ``wait`` can't reap it here)
+  kill -TERM "$1" 2>/dev/null || true
+  for _ in $(seq 1 100); do
+    kill -0 "$1" 2>/dev/null || return 0
+    sleep 0.2
+  done
+  kill -KILL "$1" 2>/dev/null || true
+}
+
+boot() {  # sock extra-flags... — start serve.py, echo its pid
+  sock="$1"; shift
+  python serve.py --network resnet50 --synthetic --unix-socket "$sock" \
+    --serve-batch 2 --max-delay-ms 50 --max-queue 64 \
+    --deadline-ms "$deadline_ms" --program-cache "$cache" \
+    "${tinycfg[@]}" "$@" >"$sock.log" 2>&1 &
+  echo $!
+}
+
+# ---- 1. single-model baseline ------------------------------------------
+sock="$dir/single.sock"
+pid=$(boot "$sock")
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_healthy "$sock" "$pid"
+python scripts/loadgen.py --unix-socket "$sock" --n 16 --rate 4 \
+  --short 90 --long 120 --deadline-ms "$deadline_ms" --assert-2xx \
+  | tee "$dir/single.out"
+stop "$pid"
+base_tput=$(python - "$dir/single.out" <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip().startswith("{")]
+tput = rows[-1].get("imgs_per_sec")
+assert isinstance(tput, (int, float)) and tput > 0, rows[-1]
+print(tput)
+EOF
+)
+
+# ---- 2. pool boot: mixed traffic, per-model counters, the report --------
+sock="$dir/pool.sock"
+pid=$(boot "$sock" "${mmflags[@]}")
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_healthy "$sock" "$pid"
+
+# aggregate throughput must hold at least HALF the single-model rate
+# (two models share one device; the pool tax must not eat the rest) and
+# model b's p99 is ceilinged while model a bursts — generous bound on a
+# shared CI box, the property is that the row is wired, not the number
+floor=$(python -c "print(round(0.5 * float('$base_tput'), 3))")
+python scripts/loadgen.py --unix-socket "$sock" --n 24 --rate 4 \
+  --short 90 --long 120 --deadline-ms "$deadline_ms" \
+  --models a=0.7,b=0.3 --burst-model a --assert-2xx \
+  --throughput-floor "$floor" --p99-ceiling-ms 30000 \
+  --report "${MULTIMODEL_OUT:-MULTIMODEL_r01.json}" \
+  | tee "$dir/pool.out"
+
+python - "$sock" <<'EOF'
+import sys
+from mx_rcnn_tpu.serve import unix_http_request
+status, m = unix_http_request(sys.argv[1], "GET", "/metrics", timeout=30)
+assert status == 200 and m["multimodel"] is True, m.get("multimodel")
+for mid in ("a", "b"):
+    c = m["models"][mid]["counters"]
+    # the per-model zero-steady-state-recompile contract
+    assert c["recompiles"] == c["warmup_programs"] == 2, (mid, c)
+    assert c["requests"] > 0, (mid, c)
+    r = m["residency"]["models"][mid]
+    assert r["resident"] == 1 and r["bytes"] > 0, (mid, r)
+p = m["pool"]["counters"]
+assert p["sched_batches"] > 0, p
+assert m["pool"]["batches"]["a"] > 0 and m["pool"]["batches"]["b"] > 0, \
+    m["pool"]
+print(f"pool metrics ok: 0 steady-state recompiles on both models, "
+      f"{p['sched_batches']} pool batches "
+      f"({p['sched_switches']} switches), both models resident")
+EOF
+stop "$pid"
+
+# ---- 3. warm pool boot: AOT across ALL models ---------------------------
+sock="$dir/warm.sock"
+pid=$(boot "$sock" "${mmflags[@]}")
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_healthy "$sock" "$pid"
+python - "$sock" <<'EOF'
+import sys
+from mx_rcnn_tpu.serve import unix_http_request
+status, m = unix_http_request(sys.argv[1], "GET", "/metrics", timeout=30)
+assert status == 200
+hits = progs = warm = 0
+for mid, doc in m["models"].items():
+    rc = doc["compile"]["counters"]
+    hits += rc["aot_hit"]
+    progs += rc["programs"]
+    warm += doc["counters"]["warmup_programs"]
+    assert rc["aot_hit"] == rc["programs"], (mid, rc)
+# the ISSUE-15 acceptance: summed across ALL registered models, the
+# second boot loaded every warmed program from the persistent cache
+assert hits == warm == progs and hits > 0, (hits, warm, progs)
+print(f"aot warm start ok: {hits}/{progs} program(s) across "
+      f"{len(m['models'])} models served from the persistent cache")
+EOF
+stop "$pid"
+trap - EXIT
+
+# ---- 4. gate the trajectory including the multimodel rows ---------------
+python scripts/perf_gate.py
+echo "multimodel smoke ok"

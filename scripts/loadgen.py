@@ -98,6 +98,26 @@ client-observed ``skip_fraction`` from response ``skipped`` flags, and
 ``dispatches_per_frame`` diffed from the server's ``/metrics`` engine
 counters).  ``--skip-floor``/``--p99-ceiling-ms`` attach the
 ``perf_gate.py`` floor/ceiling fields to the rows the gate scores.
+
+Multi-model mode (ISSUE 15): ``--models a=0.7,b=0.3`` targets a model
+pool (serve.py --models): every request carries a ``"model"`` field
+drawn from the given mix (seeded), and two scenarios run —
+
+* ``mixed`` — open-loop steady arrivals, models interleaved per the
+  mix: the aggregate-throughput workload.
+* ``burst`` — the non-burst models keep their steady share of
+  ``--rate`` while ``--burst-model`` (default: the first in the mix)
+  fires ALL its requests back-to-back mid-run: the tenant-isolation
+  workload — the sibling models' p99 under the burst is what the
+  MULTIMODEL gate's isolation ceiling scores.
+
+Each scenario prints one JSON line with per-model ``p50_ms``/
+``p99_ms``/``availability``/``error_rate`` blocks under ``"models"``
+alongside the aggregate fields, and ``--report`` writes schema
+``mxr_multimodel_report``.  ``--throughput-floor`` attaches the
+aggregate ``imgs_per_sec`` floor to the mixed row;
+``--p99-ceiling-ms`` attaches the isolation ceiling the gate enforces
+on every NON-burst model in the burst row.
 """
 
 import argparse
@@ -117,6 +137,7 @@ from mx_rcnn_tpu.serve.frontend import (encode_image_payload,  # noqa: E402
 
 REPORT_SCHEMA = "mxr_slo_report"
 STREAM_REPORT_SCHEMA = "mxr_stream_report"
+MULTIMODEL_REPORT_SCHEMA = "mxr_multimodel_report"
 REPORT_VERSION = 1
 SCENARIOS = ("steady", "bursty", "size-mix")
 MOTIONS = ("static", "pan", "scene-cut")
@@ -197,10 +218,53 @@ def parse_args(argv=None):
                          "perf_gate.py enforces)")
     ap.add_argument("--p99-ceiling-ms", type=float, default=0.0,
                     dest="p99_ceiling_ms",
-                    help="stream mode: attach this per-stream p99 "
-                         "ceiling to every report row (what perf_gate.py "
-                         "enforces)")
+                    help="stream mode: per-stream p99 ceiling attached "
+                         "to every report row; multi-model mode: the "
+                         "isolation p99 ceiling attached to the "
+                         "non-burst models in the burst row (what "
+                         "perf_gate.py enforces)")
+    ap.add_argument("--models", default="",
+                    help="multi-model mode: ID=SHARE mix (e.g. "
+                         "a=0.7,b=0.3) — every request carries a "
+                         "'model' field drawn from this mix against a "
+                         "serve.py --models pool")
+    ap.add_argument("--burst-model", default="", dest="burst_model",
+                    help="multi-model mode: the model whose requests "
+                         "all fire back-to-back in the burst scenario "
+                         "(default: first in the --models mix)")
+    ap.add_argument("--throughput-floor", type=float, default=0.0,
+                    dest="throughput_floor",
+                    help="multi-model mode: attach this aggregate "
+                         "imgs_per_sec floor to the mixed report row "
+                         "(what perf_gate.py enforces)")
     return ap.parse_args(argv)
+
+
+def parse_model_mix(spec):
+    """``a=0.7,b=0.3`` → ordered ``[(id, normalized_share), ...]``."""
+    mix = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        mid, eq, share = part.partition("=")
+        if not mid or not eq:
+            raise SystemExit(f"loadgen: bad --models entry {part!r} "
+                             "(want ID=SHARE)")
+        try:
+            val = float(share)
+        except ValueError:
+            raise SystemExit(f"loadgen: bad --models share {share!r}")
+        if val <= 0:
+            raise SystemExit(f"loadgen: --models share for {mid!r} must "
+                             "be positive")
+        if any(m == mid for m, _ in mix):
+            raise SystemExit(f"loadgen: duplicate model {mid!r}")
+        mix.append((mid, val))
+    if not mix:
+        raise SystemExit("loadgen: --models given but empty")
+    total = sum(v for _, v in mix)
+    return [(m, v / total) for m, v in mix]
 
 
 def make_payloads(args, seed=None, size_mix=False):
@@ -671,12 +735,112 @@ def stream_main(args):
             sys.exit(1)
 
 
+# -- multi-model mode (ISSUE 15) ------------------------------------------
+
+
+MM_MODEL_KEYS = ("requests", "status", "p50_ms", "p99_ms", "error_rate",
+                 "availability", "mean_queue_wait_ms")
+
+
+def assign_models(mix, n, rng):
+    """Model id per request slot: a seeded weighted draw, then a
+    guarantee that every model in the mix appears at least once (a tiny
+    ``--n`` must still exercise every tenant)."""
+    ids = [m for m, _ in mix]
+    shares = np.asarray([s for _, s in mix])
+    picks = [ids[i] for i in rng.choice(len(ids), size=n, p=shares)]
+    for j, mid in enumerate(ids):
+        if n > j and mid not in picks:
+            picks[j] = mid
+    return picks
+
+
+def multimodel_offsets(scenario, picks, burst_model, n, rate):
+    """Fire offsets for the multi-model profiles.  ``mixed`` is plain
+    steady.  ``burst``: non-burst models keep their steady slots while
+    every burst-model request fires at one instant a quarter into the
+    window — the sibling models' latency THROUGH that spike is the
+    isolation measurement."""
+    steady = schedule("steady", n, rate)
+    if scenario != "burst" or rate <= 0:
+        return steady
+    burst_at = steady[-1] * 0.25
+    return [burst_at if picks[i] == burst_model else steady[i]
+            for i in range(n)]
+
+
+def summarize_per_model(picks, results, wall):
+    """``model id → per-model summary block`` (the fields the
+    MULTIMODEL gate scores), in mix order of first appearance."""
+    out = {}
+    for mid in dict.fromkeys(picks):
+        sub = [r for p, r in zip(picks, results) if p == mid]
+        summ = summarize(sub, wall)
+        out[mid] = {k: summ[k] for k in MM_MODEL_KEYS if k in summ}
+    return out
+
+
+def multimodel_main(args):
+    """Multi-model driver: the ``mixed`` (aggregate throughput) and
+    ``burst`` (tenant isolation) scenarios against one model pool; one
+    ``mxr_multimodel_report`` doc for the gate."""
+    mix = parse_model_mix(args.models)
+    burst_model = args.burst_model or mix[0][0]
+    if burst_model not in (m for m, _ in mix):
+        raise SystemExit(f"loadgen: --burst-model {burst_model!r} not "
+                         "in the --models mix")
+    rows = []
+    all_results = []
+    for idx, scenario in enumerate(("mixed", "burst")):
+        docs = make_payloads(args, seed=args.seed + idx)
+        rng = np.random.RandomState(args.seed + 7000 + idx)
+        picks = assign_models(mix, args.n, rng)
+        for doc, mid in zip(docs, picks):
+            doc["model"] = mid
+        offsets = multimodel_offsets(scenario, picks, burst_model,
+                                     args.n, args.rate)
+        results, wall = run_requests(args, docs, offsets)
+        all_results.extend(results)
+        out = summarize(results, wall)
+        out["models"] = summarize_per_model(picks, results, wall)
+        row = {"name": scenario,
+               "mix": {m: round(s, 4) for m, s in mix},
+               **{k: v for k, v in out.items()
+                  if k in ("requests", "status", "p50_ms", "p99_ms",
+                           "error_rate", "availability", "imgs_per_sec",
+                           "wall_s", "models")}}
+        if scenario == "burst":
+            row["burst_model"] = burst_model
+            if args.p99_ceiling_ms > 0:
+                row["isolation_p99_ceiling_ms"] = args.p99_ceiling_ms
+        elif args.throughput_floor > 0:
+            row["imgs_per_sec_floor"] = args.throughput_floor
+        rows.append(row)
+        print(json.dumps({"scenario": scenario, **out}))
+
+    if args.report:
+        doc = {"schema": MULTIMODEL_REPORT_SCHEMA,
+               "version": REPORT_VERSION, "scenarios": rows}
+        with open(args.report, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+
+    if args.assert_2xx:
+        msg = assert_2xx_failure(all_results)
+        if msg is not None:
+            print(msg, file=sys.stderr)
+            sys.exit(1)
+
+
 def main(argv=None):
     args = parse_args(argv)
     if bool(args.unix_socket) == bool(args.port):
         raise SystemExit("pass exactly one of --port / --unix-socket")
     if args.fabric and not args.port:
         raise SystemExit("--fabric needs a TCP router (--port)")
+    if args.models:
+        if args.streams > 0:
+            raise SystemExit("--models and --streams are exclusive")
+        return multimodel_main(args)
     if args.streams > 0:
         return stream_main(args)
 

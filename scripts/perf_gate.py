@@ -253,6 +253,62 @@ def stream_report_rows(doc: dict) -> list:
     return rows
 
 
+def multimodel_report_rows(doc: dict) -> list:
+    """Expand an ``mxr_multimodel_report`` (scripts/loadgen.py --models)
+    into rows.  The two ISSUE-15 properties score the newest run alone:
+    the ``mixed`` scenario's aggregate ``imgs_per_sec`` against the
+    FLOOR the run pinned (``--throughput-floor`` — the pool must not
+    cost aggregate throughput vs a single-model baseline), and in the
+    ``burst`` scenario every NON-burst model's p99 against the
+    isolation CEILING (``--p99-ceiling-ms`` — one tenant's burst must
+    not blow a sibling's SLO).  Aggregate and per-model p50/p99/
+    error_rate ride along as direction=down trend rows."""
+    rows = []
+    for sc in doc.get("scenarios", []):
+        name = sc.get("name", "?")
+        for field, unit, slack in (("p50_ms", "ms", 0.0),
+                                   ("p99_ms", "ms", 0.0),
+                                   ("error_rate", "fraction",
+                                    ERROR_RATE_ABS_SLACK)):
+            v = sc.get(field)
+            if not isinstance(v, (int, float)):
+                continue
+            row = {"metric": f"mm_{name}_{field}", "value": v,
+                   "unit": unit, "direction": "down"}
+            if slack:
+                row["abs_slack"] = slack
+            rows.append(row)
+        floor = sc.get("imgs_per_sec_floor")
+        tput = sc.get("imgs_per_sec")
+        if (isinstance(floor, (int, float)) and floor > 0
+                and isinstance(tput, (int, float))):
+            rows.append({"metric": f"mm_{name}_imgs_per_sec",
+                         "value": tput, "unit": "imgs/s", "floor": floor})
+        burst_model = sc.get("burst_model")
+        ceil = sc.get("isolation_p99_ceiling_ms")
+        for mid, m in sorted((sc.get("models") or {}).items()):
+            if not isinstance(m, dict):
+                continue
+            p99 = m.get("p99_ms")
+            if isinstance(p99, (int, float)):
+                row = {"metric": f"mm_{name}_{mid}_p99_ms", "value": p99,
+                       "unit": "ms", "direction": "down"}
+                if (isinstance(ceil, (int, float)) and ceil > 0
+                        and mid != burst_model):
+                    # the isolation property: a sibling's p99 THROUGH
+                    # the burst, scored absolutely on this run alone
+                    row = {"metric": f"mm_{name}_{mid}_p99_ms",
+                           "value": p99, "unit": "ms", "ceiling": ceil}
+                rows.append(row)
+            er = m.get("error_rate")
+            if isinstance(er, (int, float)):
+                rows.append({"metric": f"mm_{name}_{mid}_error_rate",
+                             "value": er, "unit": "fraction",
+                             "direction": "down",
+                             "abs_slack": ERROR_RATE_ABS_SLACK})
+    return rows
+
+
 def load_rows(path: str) -> list:
     """Extract metric rows from one trajectory artifact.  Shapes seen in
     the wild: the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
@@ -272,6 +328,8 @@ def load_rows(path: str) -> list:
         return flywheel_report_rows(doc)
     if isinstance(doc, dict) and doc.get("schema") == "mxr_stream_report":
         return stream_report_rows(doc)
+    if isinstance(doc, dict) and doc.get("schema") == "mxr_multimodel_report":
+        return multimodel_report_rows(doc)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return startup_rows([doc["parsed"]])
     if isinstance(doc, dict) and "metric" in doc:
@@ -478,12 +536,13 @@ def main(argv=None) -> int:
                     help="trajectory files (default: --dir/BENCH_r*.json "
                          "+ --dir/SLO_r*.json + --dir/REPLICA_r*.json + "
                          "--dir/FABRIC_r*.json + --dir/FLYWHEEL_r*.json "
-                         "+ --dir/STREAM_r*.json)")
+                         "+ --dir/STREAM_r*.json + "
+                         "--dir/MULTIMODEL_r*.json)")
     ap.add_argument("--dir", default=".",
                     help="where to glob BENCH_r*.json / SLO_r*.json / "
                          "REPLICA_r*.json / FABRIC_r*.json / "
-                         "FLYWHEEL_r*.json / STREAM_r*.json when no "
-                         "paths given")
+                         "FLYWHEEL_r*.json / STREAM_r*.json / "
+                         "MULTIMODEL_r*.json when no paths given")
     ap.add_argument("--threshold", type=float, default=GATE_THRESHOLD,
                     help="allowed fractional drop vs the best prior run "
                          "(default 0.10)")
@@ -499,7 +558,8 @@ def main(argv=None) -> int:
         + sorted(glob.glob(os.path.join(args.dir, "REPLICA_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "FABRIC_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "FLYWHEEL_r*.json")))
-        + sorted(glob.glob(os.path.join(args.dir, "STREAM_r*.json"))))
+        + sorted(glob.glob(os.path.join(args.dir, "STREAM_r*.json")))
+        + sorted(glob.glob(os.path.join(args.dir, "MULTIMODEL_r*.json"))))
     if not paths:
         print("perf_gate: no BENCH_*.json / SLO_*.json files found",
               file=sys.stderr)

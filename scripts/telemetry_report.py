@@ -25,7 +25,10 @@ Streams from a fabric router (serve.py --fabric) get a "fabric health"
 section on top: membership churn (member_joined / member_evicted /
 member_quarantined), circuit-breaker opens, hedges fired/won, retries,
 partitions, and rolling reloads, zeros included;
-script/fabric_smoke.sh asserts on it.
+script/fabric_smoke.sh asserts on it.  Streams from a model pool
+(serve.py --models) get a "model pool" section: weight page-in/out and
+cross-model scheduler counters plus the per-model paging variants,
+zeros included; script/multimodel_smoke.sh asserts on it.
 
 Streams carrying ``pipeline_cell`` meta rows — a live run of ``bench.py
 --mode pipeline``, or its ``--sweep-out`` JSONL passed directly as a

@@ -200,7 +200,79 @@ def parse_args():
     parser.add_argument("--capture-shard-records", type=int, default=32,
                         dest="capture_shard_records",
                         help="records per spilled shard pair")
+    # -- multi-model serving (ISSUE 15) — all opt-in; without --models
+    # the single-model boot path is byte-for-byte unchanged
+    parser.add_argument("--models", default="",
+                        help="serve SEVERAL models from one process: "
+                             "comma-separated ID=NETWORK entries (e.g. "
+                             "'box=resnet50,mask=resnet101').  Requests "
+                             "route with /predict?model=ID (default: the "
+                             "first entry); each model gets its own "
+                             "config, Predictor, program registry/AOT "
+                             "subtree, bucket queues, and SLO controller. "
+                             "Single-process mode only")
+    parser.add_argument("--model-arg", action="append", default=[],
+                        dest="model_arg", metavar="ID:KEY=VALUE",
+                        help="per-model override, repeatable.  KEYs: "
+                             "prefix, epoch (checkpoint source), "
+                             "cfg (an extra --cfg style PATH=VALUE), "
+                             "pin (1 = never page this model's weights "
+                             "out), weight (scheduling/SLO class, "
+                             "default 1.0), target-p99-ms (per-model SLO "
+                             "controller target; overrides the global "
+                             "--target-p99-ms)")
+    parser.add_argument("--weight-budget-mb", type=float, default=0.0,
+                        dest="weight_budget_mb",
+                        help="device weight-residency byte budget for "
+                             "--models: param trees beyond it are paged "
+                             "host<->device (LRU by last dispatch, "
+                             "pinned models exempt).  0 = unbounded")
     return parser.parse_args()
+
+
+def parse_model_specs(models: str, model_args) -> list:
+    """``--models a=resnet50,b=vgg16`` + repeated ``--model-arg
+    ID:KEY=VALUE`` → ordered spec dicts (first entry = default model)."""
+    specs = []
+    by_id = {}
+    for entry in models.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        mid, _, network = entry.partition("=")
+        mid, network = mid.strip(), network.strip()
+        if not mid or not network:
+            raise SystemExit(f"--models entries are ID=NETWORK, got "
+                             f"{entry!r}")
+        if mid in by_id:
+            raise SystemExit(f"--models: duplicate model id {mid!r}")
+        spec = {"id": mid, "network": network, "prefix": None,
+                "epoch": None, "cfg": [], "pin": False, "weight": 1.0,
+                "target_p99_ms": None}
+        by_id[mid] = spec
+        specs.append(spec)
+    for arg in model_args or []:
+        mid, sep, kv = arg.partition(":")
+        key, sep2, val = kv.partition("=")
+        if not sep or not sep2 or mid.strip() not in by_id:
+            raise SystemExit(f"--model-arg is ID:KEY=VALUE with ID from "
+                             f"--models, got {arg!r}")
+        spec, key = by_id[mid.strip()], key.strip().replace("-", "_")
+        if key == "cfg":
+            spec["cfg"].append(val)
+        elif key == "pin":
+            spec["pin"] = val.strip().lower() in ("1", "true", "yes")
+        elif key == "weight":
+            spec["weight"] = float(val)
+        elif key == "target_p99_ms":
+            spec["target_p99_ms"] = float(val)
+        elif key in ("prefix", "epoch"):
+            spec[key] = int(val) if key == "epoch" else val
+        else:
+            raise SystemExit(f"--model-arg: unknown key {key!r}")
+    if not specs:
+        raise SystemExit("--models parsed to zero entries")
+    return specs
 
 
 def _install_signals(done: threading.Event, hard_cleanup=None):
@@ -228,9 +300,11 @@ def _install_signals(done: threading.Event, hard_cleanup=None):
         signal.signal(sig, _on_signal)
 
 
-def _build_engine(args, cfg):
+def _build_engine(args, cfg, external: bool = False):
     """checkpoint → Predictor → started ServeEngine (single + replica
-    paths share this; the supervisor parent never builds one)."""
+    paths share this; the supervisor parent never builds one).
+    ``external=True`` (multi-model pool mode) skips the engine's own
+    dispatcher thread — the ModelPool flushes it instead."""
     from mx_rcnn_tpu.eval import Predictor
     from mx_rcnn_tpu.models import build_model
     from mx_rcnn_tpu.serve import ServeEngine, ServeOptions
@@ -259,8 +333,66 @@ def _build_engine(args, cfg):
             sample_every=args.capture_sample,
             shard_records=args.capture_shard_records,
             byte_budget=args.capture_bytes))
-    engine.start()
+    engine.start(external=external)
     return predictor, engine
+
+
+def _build_pool(args):
+    """--models → a started :class:`ModelPool`: per model, its own
+    config/Predictor/engine (external-dispatch) + per-model warmup, one
+    cross-model dispatcher, LRU weight residency under
+    --weight-budget-mb, and a per-model SLO controller when a p99 target
+    is set.  Returns (pool, streams) — streams only under --stream."""
+    from mx_rcnn_tpu.serve import (ControllerOptions, ModelPool,
+                                   SLOController, StreamManager,
+                                   StreamOptions, warmup)
+
+    specs = parse_model_specs(args.models, args.model_arg)
+    pool = ModelPool(
+        budget_bytes=int(args.weight_budget_mb * (1 << 20)))
+    pool.start()
+    streams = {}
+    for i, spec in enumerate(specs):
+        margs = argparse.Namespace(**vars(args))
+        margs.network = spec["network"]
+        margs.cfg = list(args.cfg) + list(spec["cfg"])
+        if spec["prefix"] is not None:
+            margs.prefix = spec["prefix"]
+        if spec["epoch"] is not None:
+            margs.epoch = spec["epoch"]
+        if i > 0:
+            # one capture sink per process: shard files are not
+            # model-namespaced, so only the default model captures
+            margs.capture_dir = ""
+        cfg = config_from_args(margs, train=False)
+        predictor, engine = _build_engine(margs, cfg, external=True)
+        target = spec["target_p99_ms"]
+        if target is None and args.target_p99_ms > 0:
+            target = args.target_p99_ms
+        controller = None
+        if target:
+            controller = SLOController(engine, ControllerOptions(
+                target_p99_ms=target,
+                interval_s=args.slo_interval_ms / 1e3,
+                window_s=args.slo_window_s, label=spec["id"]))
+        pool.add_model(spec["id"], cfg, predictor, engine,
+                       controller=controller, pinned=spec["pin"],
+                       weight=spec["weight"])
+        # warm THIS model before building the next: the most recent
+        # owning registry points the process-global jax compilation
+        # cache at its dtype dir, so compiles must land while their
+        # model's registry is the active one for AOT markers to agree
+        # with where the executables persisted
+        warmup(engine)
+        if args.stream:
+            sm = StreamManager(engine, StreamOptions(
+                skip_thresh=args.stream_skip_thresh,
+                max_skip=args.stream_max_skip))
+            sm.warmup()
+            streams[spec["id"]] = sm
+        if controller is not None:
+            controller.start()
+    return pool, streams
 
 
 def main_single(args):
@@ -336,6 +468,42 @@ def main_single(args):
         controller.stop()
     engine.stop()
     obs.close(extra={"serve": engine.metrics()})
+
+
+def main_multimodel(args):
+    """One process, N models (--models): a ModelPool behind the single
+    frontend — zero-recompile per-model routing, cross-model batch
+    interleaving, bounded weight residency, per-model SLO isolation."""
+    from mx_rcnn_tpu.serve import make_server
+
+    if not args.unix_socket and not args.port:
+        raise SystemExit("pass --port or --unix-socket")
+    obs = start_observability(args, "serve",
+                              run_meta={"models": args.models,
+                                        "serve_batch": args.serve_batch,
+                                        "max_delay_ms": args.max_delay_ms},
+                              configure_telemetry=True)
+    pool, streams = _build_pool(args)
+    default = pool.default_model
+    server = make_server(pool.engine_for(default),
+                         port=args.port or None, host=args.host,
+                         unix_socket=args.unix_socket or None,
+                         stream=streams.get(default), pool=pool,
+                         streams=streams)
+    done = threading.Event()
+    _install_signals(done)
+    t = threading.Thread(target=server.serve_forever, name="serve-http",
+                         daemon=True)
+    t.start()
+    where = args.unix_socket or f"http://{args.host}:{args.port}"
+    logger.info("serving %d model(s) %s on %s (batch=%d, weight budget "
+                "%.0f MB)", len(pool.model_ids()), pool.model_ids(),
+                where, args.serve_batch, args.weight_budget_mb)
+    done.wait()
+    logger.info("shutting down: %s", pool.metrics()["pool"])
+    server.shutdown()
+    pool.stop()
+    obs.close(extra={"serve": pool.metrics()})
 
 
 def main_replica(args):
@@ -544,6 +712,14 @@ def choose_mode(args) -> str:
 
 def main(args):
     mode = choose_mode(args)
+    if getattr(args, "models", ""):
+        # the pool shares one device owner (its dispatcher thread); the
+        # multi-process planes each bind a full device stack per child,
+        # so --models composes with none of them (yet)
+        if mode != "single":
+            raise SystemExit(f"--models requires single-process mode "
+                             f"(got mode {mode!r})")
+        return main_multimodel(args)
     if getattr(args, "stream", False) and mode != "single":
         # stream state (reference frames, seq high-water marks) lives in
         # ONE engine's process; routing frames of a stream across

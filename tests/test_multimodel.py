@@ -1,0 +1,376 @@
+"""Multi-model serving tier-1 tests (CPU).
+
+The :class:`~mx_rcnn_tpu.serve.pool.ModelPool` contract from three
+angles: (1) registry + frontend routing — ``?model=``/doc-field
+resolution, default-model fallback, 404s for unknown ids and for
+explicit ids on a pool-less server; (2) device weight residency — the
+byte budget holds through a paging stress loop (device bytes asserted
+under budget after EVERY operation), LRU picks the coldest victim,
+pinned models are never paged out, and a paged-out model still answers
+correctly (params are runtime args — zero recompiles by construction);
+(3) the real thing — two synthetic-weight models with distinct config
+digests behind one socket, per-model warmup, mixed cross-model traffic,
+and the acceptance assert: each model's engine recompile counter stays
+equal to its warmup_programs (zero steady-state recompiles per model).
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.serve import (ModelPool, ServeEngine, ServeOptions,
+                               encode_image_payload, make_server,
+                               param_nbytes, unix_http_request, warmup)
+from tests.test_serve import FakePredictor, raw_image, tiny_cfg
+
+
+def make_pool_engine(cfg, **opts):
+    defaults = dict(batch_size=2, max_delay_ms=1.0, max_queue=32)
+    defaults.update(opts)
+    eng = ServeEngine(FakePredictor(cfg), cfg, ServeOptions(**defaults))
+    eng.start(external=True)
+    return eng
+
+
+def add_fake_model(pool, cfg, mid, params=None, **kw):
+    pred = FakePredictor(cfg)
+    if params is not None:
+        pred.params = params
+    eng = ServeEngine(pred, cfg, ServeOptions(
+        batch_size=2, max_delay_ms=1.0, max_queue=32))
+    eng.start(external=True)
+    pool.add_model(mid, cfg, pred, eng, **kw)
+    return pred, eng
+
+
+def mib_params(n_mib):
+    return {"w": np.zeros((n_mib, 1 << 18), np.float32)}  # n MiB
+
+
+# -- registry + routing ----------------------------------------------------
+
+
+def test_pool_registry_defaults_and_bad_ids():
+    cfg = tiny_cfg()
+    pool = ModelPool()
+    with pytest.raises(KeyError):
+        pool.entry()  # empty pool
+    add_fake_model(pool, cfg, "a")
+    add_fake_model(pool, cfg, "b")
+    assert pool.model_ids() == ["a", "b"]
+    assert pool.default_model == "a"
+    assert pool.entry().model_id == "a"          # None -> default
+    assert pool.entry("b").model_id == "b"
+    with pytest.raises(KeyError):
+        pool.entry("zzz")
+    with pytest.raises(ValueError):
+        add_fake_model(pool, cfg, "a")           # duplicate id
+    with pytest.raises(ValueError):
+        add_fake_model(pool, cfg, "x/y")         # path-hostile id
+    pool.stop()
+
+
+def test_pool_frontend_routing_and_404s(tmp_path):
+    cfg = tiny_cfg()
+    pool = ModelPool().start()
+    pred_a, _ = add_fake_model(pool, cfg, "a")
+    pred_b, _ = add_fake_model(pool, cfg, "b")
+    sock = str(tmp_path / "pool.sock")
+    server = make_server(pool.engine_for(), unix_socket=sock, pool=pool)
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    try:
+        status, h = unix_http_request(sock, "GET", "/healthz")
+        assert status == 200 and h["models"] == ["a", "b"]
+
+        # default (no selector) -> model "a"; ?model= and doc field route
+        img = raw_image(60, 100, 9)
+        assert unix_http_request(sock, "POST", "/predict",
+                                 encode_image_payload(img),
+                                 timeout=60)[0] == 200
+        assert unix_http_request(sock, "POST", "/predict?model=b",
+                                 encode_image_payload(img),
+                                 timeout=60)[0] == 200
+        doc = encode_image_payload(img)
+        doc["model"] = "b"
+        assert unix_http_request(sock, "POST", "/predict", doc,
+                                 timeout=60)[0] == 200
+        assert len(pred_a.batches) == 1 and len(pred_b.batches) == 2
+
+        # unknown model: 404 with the id echoed, traffic unharmed
+        status, err = unix_http_request(
+            sock, "POST", "/predict?model=zzz",
+            encode_image_payload(img), timeout=60)
+        assert status == 404 and "zzz" in err["error"]
+
+        # pool-mode /metrics: multimodel doc with per-model engines,
+        # aggregated counters, and the pool scheduling/residency block
+        status, m = unix_http_request(sock, "GET", "/metrics")
+        assert status == 200 and m["multimodel"] is True
+        assert m["default_model"] == "a"
+        assert set(m["models"]) == {"a", "b"}
+        # routing 404s never reach an engine: 3 served requests only
+        assert m["counters"]["requests"] == 3
+        assert m["pool"]["counters"]["sched_batches"] >= 3
+        assert m["residency"]["resident_models"] == 2
+
+        # prometheus exposition carries one rank per model + "pool"
+        status, raw = unix_http_request(
+            sock, "GET", "/metrics?format=prometheus")
+        text = raw if isinstance(raw, str) else raw.get("raw", "")
+        assert 'rank="a"' in text and 'rank="b"' in text
+        assert 'rank="pool"' in text
+        assert "mxr_serve_sched_batches_total" in text
+    finally:
+        server.shutdown()
+        server.server_close()
+        pool.stop()
+
+
+def test_explicit_model_without_pool_is_404(tmp_path):
+    # single-model boot: the pool-less server must refuse explicit model
+    # selectors loudly instead of silently serving the wrong weights
+    cfg = tiny_cfg()
+    engine = ServeEngine(FakePredictor(cfg), cfg, ServeOptions(
+        batch_size=2, max_delay_ms=1.0, max_queue=8)).start()
+    sock = str(tmp_path / "single.sock")
+    server = make_server(engine, unix_socket=sock)
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    try:
+        img = raw_image(60, 100, 5)
+        status, err = unix_http_request(
+            sock, "POST", "/predict?model=a",
+            encode_image_payload(img), timeout=60)
+        assert status == 404 and "routing not enabled" in err["error"]
+        # no selector: byte-for-byte the old single-model path
+        assert unix_http_request(sock, "POST", "/predict",
+                                 encode_image_payload(img),
+                                 timeout=60)[0] == 200
+    finally:
+        server.shutdown()
+        server.server_close()
+        engine.stop()
+
+
+# -- cross-model scheduling ------------------------------------------------
+
+
+def test_pool_interleaves_models_and_counts_switches():
+    cfg = tiny_cfg()
+    pool = ModelPool().start()
+    pred_a, eng_a = add_fake_model(pool, cfg, "a", weight=2.0)
+    pred_b, eng_b = add_fake_model(pool, cfg, "b", weight=1.0)
+    try:
+        futs = []
+        for i in range(8):
+            eng = eng_a if i % 2 else eng_b
+            futs.append(eng.submit(raw_image(60, 100, i)))
+        for f in futs:
+            f.result(timeout=60)
+        assert pred_a.batches and pred_b.batches  # both models served
+        m = pool.metrics()
+        assert m["pool"]["counters"]["sched_batches"] >= 4
+        assert m["pool"]["counters"]["sched_switches"] >= 1
+        assert m["pool"]["batches"]["a"] >= 1
+        assert m["pool"]["batches"]["b"] >= 1
+        assert m["counters"]["requests"] == 8
+        assert m["queue_depth"] == 0
+    finally:
+        pool.stop()
+
+
+def test_pool_slo_controller_per_model_labels():
+    from mx_rcnn_tpu.serve import ControllerOptions, SLOController
+
+    cfg = tiny_cfg()
+    pool = ModelPool().start()
+    pred_a, eng_a = add_fake_model(pool, cfg, "a")
+    ctrl = SLOController(eng_a, ControllerOptions(
+        target_p99_ms=150.0, label="a"))
+    pool.entry("a").controller = ctrl
+    try:
+        assert ctrl.state()["label"] == "a"
+        # controller acts on ITS engine only — the pool only wires one
+        # controller per entry, there is no shared admission state
+        assert ctrl.engine is eng_a
+    finally:
+        pool.stop()  # stops the controller too (idempotent if unstarted)
+
+
+# -- weight residency ------------------------------------------------------
+
+
+def test_paging_budget_stress_lru_and_pinned(caplog):
+    cfg = tiny_cfg()
+    budget = 9 * (1 << 20)
+    pool = ModelPool(budget_bytes=budget).start()
+    # pin = 4 MiB always resident; a/b/c = 4 MiB each, only ONE fits
+    # beside the pinned set at a time
+    preds = {}
+    preds["pin"], _ = add_fake_model(pool, cfg, "pin",
+                                     params=mib_params(4), pinned=True)
+    for mid in ("a", "b", "c"):
+        preds[mid], _ = add_fake_model(pool, cfg, mid,
+                                       params=mib_params(4))
+    try:
+        assert pool.resident_bytes() <= budget
+
+        # stress: 30 interleaved residency demands; the budget must hold
+        # after EVERY step and the pinned model must never page out
+        rng = np.random.RandomState(0)
+        for i in range(30):
+            mid = ("a", "b", "c")[rng.randint(3)]
+            pool.ensure_resident(mid)
+            assert pool.entry(mid).resident
+            assert pool.resident_bytes() <= budget, (i, mid)
+            assert pool.entry("pin").resident
+        assert pool.entry("pin").page_outs == 0
+        assert pool.counters["weight_page_out"] >= 1
+        assert pool.counters["weight_page_in"] >= 1
+
+        # LRU: touch order a, b -> demanding c must evict a (coldest)
+        pool.ensure_resident("a")
+        time.sleep(0.002)
+        pool.ensure_resident("b")  # pages a out already (budget of one)
+        time.sleep(0.002)
+        pool.ensure_resident("c")
+        assert not pool.entry("a").resident
+        assert pool.entry("c").resident
+
+        # a paged-out model still answers (params travel as runtime
+        # args) — and dispatch pages it back in via ensure_resident
+        eng_a = pool.engine_for("a")
+        assert eng_a.submit(raw_image(60, 100, 3)).result(timeout=60)
+        assert pool.entry("a").resident
+
+        # residency doc shape: budget, live bytes, per-model gauges
+        res = pool.residency()
+        assert res["budget_bytes"] == budget
+        assert res["device_bytes"] <= budget
+        assert set(res["models"]) == {"pin", "a", "b", "c"}
+        assert res["models"]["pin"]["pinned"] is True
+        assert res["models"]["pin"]["page_outs"] == 0
+    finally:
+        pool.stop()
+
+
+def test_paging_restores_identical_weights():
+    # page-out snapshots to host, page-in device_puts the snapshot: the
+    # values a model serves with must survive the round trip exactly
+    import jax
+
+    cfg = tiny_cfg()
+    rng = np.random.RandomState(3)
+    w = {"k": rng.rand(256, 256).astype(np.float32)}
+    pool = ModelPool(budget_bytes=2 * w["k"].nbytes
+                     + (1 << 16)).start()
+    pred_a, _ = add_fake_model(
+        pool, cfg, "a", params=jax.device_put(dict(w)))
+    pred_b, _ = add_fake_model(
+        pool, cfg, "b", params=jax.device_put(
+            {"k": np.zeros((256, 256), np.float32)}))
+    pred_c, _ = add_fake_model(
+        pool, cfg, "c", params=jax.device_put(
+            {"k": np.ones((256, 256), np.float32)}))
+    try:
+        assert not pool.entry("a").resident  # evicted by b+c arriving
+        pool.ensure_resident("a")            # ...and paged back in
+        assert pool.entry("a").resident
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(pred_a.params["k"])), w["k"])
+        assert param_nbytes(pred_a.params) == w["k"].nbytes
+    finally:
+        pool.stop()
+
+
+def test_pinned_set_over_budget_is_refused():
+    cfg = tiny_cfg()
+    pool = ModelPool(budget_bytes=6 * (1 << 20))
+    add_fake_model(pool, cfg, "p1", params=mib_params(4), pinned=True)
+    with pytest.raises(ValueError):
+        add_fake_model(pool, cfg, "p2", params=mib_params(4), pinned=True)
+    pool.stop()
+
+
+# -- the real thing --------------------------------------------------------
+
+
+def test_multimodel_e2e_two_real_models_zero_recompiles(tmp_path):
+    """Two synthetic-weight models (distinct config digests, hence
+    disjoint program keys and AOT subtrees) behind one socket: per-model
+    warmup compiles one program per orientation EACH, mixed cross-model
+    traffic serves with zero further recompiles per model (the
+    acceptance counter assert), and the pool scheduler interleaves both
+    engines."""
+    import jax
+
+    from mx_rcnn_tpu import telemetry
+    from mx_rcnn_tpu.compile import config_digest
+    from mx_rcnn_tpu.eval import Predictor
+    from mx_rcnn_tpu.models import build_model, init_params
+    from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
+
+    cfg_a = tiny_cfg()
+    # a digest-changing knob: model b is a different deployment of the
+    # same network — the realistic multi-tenant shape on one chip
+    cfg_b = tiny_cfg().replace(
+        TEST=dataclasses.replace(tiny_cfg().TEST, NMS=0.31))
+    assert config_digest(cfg_a) != config_digest(cfg_b)
+
+    telemetry.configure(str(tmp_path / "tel"), run_meta={"driver": "test"})
+    pool = ModelPool().start()
+    for mid, cfg in (("a", cfg_a), ("b", cfg_b)):
+        model = build_model(cfg)
+        params = denormalize_for_save(
+            init_params(model, cfg, jax.random.PRNGKey(0), 2, (96, 128)),
+            cfg)
+        pred = Predictor(model, params, cfg)
+        engine = ServeEngine(pred, cfg, ServeOptions(
+            batch_size=2, max_delay_ms=5.0, max_queue=16))
+        engine.start(external=True)
+        pool.add_model(mid, cfg, pred, engine)
+        assert warmup(engine) == 2  # one program per orientation
+
+    sock = str(tmp_path / "mm.sock")
+    server = make_server(pool.engine_for(), unix_socket=sock, pool=pool)
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    try:
+        status, r = unix_http_request(sock, "GET", "/readyz")
+        assert status == 200 and r["ready"] is True
+        assert set(r["models"]) == {"a", "b"}
+
+        rng = np.random.RandomState(11)
+        shapes = ((60, 100), (100, 60), (48, 90), (90, 48))
+        for i, (h, w) in enumerate(shapes * 2):
+            img = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+            doc = encode_image_payload(img)
+            doc["model"] = "ab"[i % 2]
+            status, resp = unix_http_request(sock, "POST", "/predict",
+                                             doc, timeout=300)
+            assert status == 200, resp
+            assert resp["detections"] is not None
+
+        # the acceptance assert: per-model recompile counters — every
+        # model's engine saw exactly its warmup compiles and not one more
+        status, m = unix_http_request(sock, "GET", "/metrics")
+        assert status == 200
+        for mid in ("a", "b"):
+            c = m["models"][mid]["counters"]
+            assert c["warmup_programs"] == 2, (mid, c)
+            assert c["recompiles"] == c["warmup_programs"], (mid, c)
+        assert m["counters"]["recompiles"] == 4  # 2 models x 2 buckets
+        assert m["pool"]["batches"]["a"] >= 1
+        assert m["pool"]["batches"]["b"] >= 1
+        summ = telemetry.get().summary()
+        assert (summ["counters"]["serve/recompile"]
+                == summ["counters"]["serve/warmup_programs"] == 4)
+    finally:
+        server.shutdown()
+        server.server_close()
+        pool.stop()
+        telemetry.shutdown()

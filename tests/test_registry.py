@@ -148,6 +148,55 @@ def test_lookup_lru_eviction_and_rebuild():
         reg.lookup("nope")
 
 
+def test_multimodel_lru_pressure_pinned_registry_never_evicts():
+    """The model-pool contract on the registry: each model owns its own
+    registry (so one model's pressure never evicts a sibling's
+    programs), LRU eviction under pressure increments the counter and
+    an evicted callable is rebuilt on next lookup, and a PINNED
+    registry — the pool pins the hot model's — never evicts no matter
+    how far past ``max_programs`` it grows."""
+    hot = ProgramRegistry(max_programs=2, pinned=True)
+    cold = ProgramRegistry(max_programs=2)
+    built = {"hot": [], "cold": []}
+
+    def make_builder(name):
+        def builder(*static):
+            built[name].append(static)
+            return lambda: (name, static)
+        return builder
+
+    hot.register("fn", make_builder("hot"))
+    cold.register("fn", make_builder("cold"))
+
+    # pinned: four distinct programs live in a max_programs=2 registry
+    hot_fns = [hot.lookup("fn", (s,)) for s in "abcd"]
+    assert hot.counters["evictions"] == 0
+    assert len(hot._fns) == 4
+    for s, fn in zip("abcd", hot_fns):
+        assert hot.lookup("fn", (s,)) is fn  # all still cached
+    assert built["hot"] == [("a",), ("b",), ("c",), ("d",)]
+    assert hot.snapshot()["pinned"] is True
+
+    # the cold sibling under identical pressure evicts...
+    cold_a = cold.lookup("fn", ("a",))
+    for s in "bcd":
+        cold.lookup("fn", (s,))
+    assert cold.counters["evictions"] == 2
+    assert len(cold._fns) == 2
+    # ...and an evicted program is rebuilt, not lost
+    assert cold.lookup("fn", ("a",)) is not cold_a
+    assert built["cold"].count(("a",)) == 2
+    # cross-model isolation: cold's churn never touched hot's cache
+    assert hot.counters["evictions"] == 0 and len(hot._fns) == 4
+
+    # pinning is mutable at runtime (pool re-pins on policy change):
+    # unpinning re-enables the bound on the NEXT insert
+    hot.pinned = False
+    hot.lookup("fn", ("e",))
+    assert hot.counters["evictions"] == 3  # trimmed 5 -> 2
+    assert len(hot._fns) == 2
+
+
 def test_snapshot_shape_and_digest_stability():
     from mx_rcnn_tpu.config import generate_config
 
