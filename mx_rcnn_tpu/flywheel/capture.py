@@ -142,14 +142,20 @@ class RequestCapture:
         """Record a served batch.
 
         ``entries``: iterable of ``(pixels, raw_hw, orig_hw, records)``
-        where ``pixels`` is the staged uint8 HWC buffer the model saw,
+        or ``(pixels, raw_hw, orig_hw, records, trace_id)`` where
+        ``pixels`` is the staged uint8 HWC buffer the model saw,
         ``raw_hw`` its valid extent, ``orig_hw`` the pre-staging image
-        dims (detection boxes are in those original coordinates), and
-        ``records`` the detection records returned to the client.
+        dims (detection boxes are in those original coordinates),
+        ``records`` the detection records returned to the client, and
+        ``trace_id`` (optional 5th element) the distributed-trace id the
+        request served under — provenance that lets a mined hard example
+        link back to the serving trace that produced it.
         """
         spill = None
         with self._lock:
-            for pixels, raw_hw, orig_hw, records in entries:
+            for entry in entries:
+                pixels, raw_hw, orig_hw, records = entry[:4]
+                trace_id = entry[4] if len(entry) > 4 else None
                 self._seen += 1
                 if (self._seen - 1) % self.opts.sample_every != 0:
                     self.counters["sampled_out"] += 1
@@ -175,6 +181,8 @@ class RequestCapture:
                          "bbox": [float(v) for v in r["bbox"]]}
                         for r in records[:MAX_DETS_PER_RECORD]],
                 }
+                if trace_id is not None:
+                    meta["trace_id"] = str(trace_id)
                 self._pending.append((meta, np.ascontiguousarray(
                     pixels, dtype=np.uint8)))
                 self.counters["captured"] += 1

@@ -3,7 +3,9 @@
 Reads the ``shard-*.jsonl`` rows a :class:`~mx_rcnn_tpu.flywheel.capture.
 RequestCapture` spilled, scores each record's hardness, and writes the
 top-K as an atomic ``mined-<digest>.json`` manifest with full provenance
-(source shard, request id, model generation that served it).
+(source shard, request id, model generation that served it, and — when the
+serving path ran with distributed tracing on — the trace id, so a hard
+example links back to the exact request trace that produced it).
 
 Hardness combines the three signals the capture stage recorded:
 
@@ -91,6 +93,7 @@ def mine_shards(capture_dir, top_k=64, min_label_score=0.3):
                     "hardness": score,
                     "signals": signals,
                     "generation": row.get("generation", 0),
+                    "trace_id": row.get("trace_id"),
                     "bucket": row["bucket"],
                     "raw_hw": row["raw_hw"],
                     "orig_hw": row["orig_hw"],

@@ -66,7 +66,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from mx_rcnn_tpu import telemetry
-from mx_rcnn_tpu.telemetry import Hist
+from mx_rcnn_tpu.telemetry import Hist, tracectx
+from mx_rcnn_tpu.telemetry.tracectx import TraceContext
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data.image import bucket_shape, stage_raw_to_bucket
 from mx_rcnn_tpu.data.loader import prepare_image
@@ -163,11 +164,11 @@ class ServeFuture:
 class _Request:
     __slots__ = ("image", "im_info", "t_enqueue", "deadline", "bucket",
                  "future", "raw_hw", "ratio", "orig_hw", "staged",
-                 "staged_hw", "stream")
+                 "staged_hw", "stream", "trace", "rid")
 
     def __init__(self, image, im_info, t_enqueue, deadline, bucket=None,
                  raw_hw=None, ratio=None, orig_hw=None, staged=None,
-                 staged_hw=None, stream=None):
+                 staged_hw=None, stream=None, trace=None):
         self.image = image          # bucket-padded network input, or (in
         # serve_e2e mode) the STAGED raw uint8 bucket array
         self.im_info = im_info
@@ -187,6 +188,11 @@ class _Request:
         self.staged_hw = staged_hw
         self.stream = stream        # stream_id when submitted via a
         # StreamManager; lets the flush side count cross-stream coalescing
+        self.trace = trace          # TraceContext when the request is part
+        # of a distributed trace (tracectx); None otherwise
+        self.rid = None             # per-engine request id, assigned at
+        # flush time ONLY for batches carrying a traced request — the
+        # batch-causality key ("my request shared a dispatch with rids X")
         self.future = ServeFuture()
 
 
@@ -283,6 +289,9 @@ class ServeEngine:
         # check per batch, and the NULL sink raises if recorded into.
         from mx_rcnn_tpu.flywheel.capture import NULL_CAPTURE
         self.capture = NULL_CAPTURE
+        # distributed-tracing rid counter (see _Request.rid); only
+        # advanced when tracing is enabled AND a batch carries a trace
+        self._next_rid = 0
         # StreamManager attaches itself here; /metrics grows a "stream"
         # section when set.  The engine never calls into it — streaming
         # stays a layer above the batcher.
@@ -475,13 +484,17 @@ class ServeEngine:
 
     def submit(self, image: np.ndarray,
                deadline_ms: Optional[float] = None,
-               stream: Optional[str] = None) -> ServeFuture:
+               stream: Optional[str] = None,
+               trace: Optional[TraceContext] = None) -> ServeFuture:
         """Enqueue one raw RGB HWC image (uint8 or float).  Returns a
         :class:`ServeFuture`; raises :class:`RejectedError` immediately
         when the queue is full or the engine is stopped.  ``stream`` tags
         the request with its originating stream_id (StreamManager) so the
         flush side can account cross-stream batch sharing — it changes
-        nothing about routing, batching, or the forward."""
+        nothing about routing, batching, or the forward.  ``trace``
+        (a :class:`~mx_rcnn_tpu.telemetry.tracectx.TraceContext`) rides
+        the request so the flush side can emit batch-causality spans —
+        equally inert for routing and batching."""
         if image.ndim != 3 or image.shape[2] != 3:
             raise ValueError(f"expected (H, W, 3) RGB image, "
                              f"got shape {tuple(image.shape)}")
@@ -536,7 +549,8 @@ class ServeEngine:
         deadline = now + deadline_ms / 1e3 if deadline_ms > 0 else None
         req = _Request(prepared, im_info, now, deadline, bucket=key,
                        raw_hw=raw_hw, ratio=ratio, orig_hw=orig_hw,
-                       staged=staged, staged_hw=staged_hw, stream=stream)
+                       staged=staged, staged_hw=staged_hw, stream=stream,
+                       trace=trace)
         with self._cond:
             if self._stop:
                 self.counters["rejected"] += 1
@@ -742,10 +756,15 @@ class ServeEngine:
                            + [reqs[-1].im_info] * pad)
         tel.gauge("serve/batch_fill", len(reqs) / B)
         tel.gauge("serve/pad_ratio", pad / B)
+        # distributed tracing: tracing-off costs exactly this ONE
+        # attribute check per batch (the capture contract) — phases stay
+        # None and every trace branch below is a no-op
+        tracer = tracectx.get()
+        phases = {} if tracer.enabled else None
         if self.opts.serve_e2e:
-            xfer = self._forward_e2e(reqs, images, im_info, tel)
+            xfer = self._forward_e2e(reqs, images, im_info, tel, phases)
         else:
-            xfer = self._forward_legacy(reqs, images, im_info, tel)
+            xfer = self._forward_legacy(reqs, images, im_info, tel, phases)
         # latency distributions: service time once per batch, end-to-end
         # request time once per request (global + per-bucket family) —
         # into the engine's own Hists AND the active sink, so the SLO
@@ -786,14 +805,72 @@ class ServeEngine:
             tel.counter("stream/batch_frames", stream_frames)
             if len(stream_ids) > 1:
                 tel.counter("stream/coalesced_batches")
+        if tracer.enabled:
+            self._emit_trace_spans(tracer, reqs, now, done, pad, B, phases)
         if self.capture.enabled:
             entries = []
             for r in reqs:
                 px, hw = ((r.image, r.raw_hw) if self.opts.serve_e2e
                           else (r.staged, r.staged_hw))
                 if px is not None:
-                    entries.append((px, hw, r.orig_hw, r.future._result))
+                    entries.append((px, hw, r.orig_hw, r.future._result,
+                                    r.trace.trace_id
+                                    if r.trace is not None else None))
             self.capture.record_batch(entries, self.generation)
+
+    def _emit_trace_spans(self, tracer, reqs: List[_Request],
+                          t_start: float, t_done: float, pad: int, B: int,
+                          phases: Optional[dict]):
+        """The batch-causality spans.  For every traced request in the
+        flush: an ``engine/request`` span (rid, batch-peer rids, queue
+        position, pad fraction, bucket, occupancy) parented on the
+        request's incoming context, an ``engine/dispatch`` child naming
+        every rid that shared the device program run, and per-phase
+        children (h2d/forward/readback/postprocess) from the measured
+        batch phase durations — so a slow trace resolves to WHICH wait:
+        queue residence behind peers, a cold compile in the forward, or
+        a fat readback."""
+        traced = [r for r in reqs if r.trace is not None and r.trace.sampled]
+        if not traced:
+            return
+        with self._lock:
+            for r in reqs:
+                if r.rid is None:
+                    r.rid = self._next_rid
+                    self._next_rid += 1
+        rids = [r.rid for r in reqs]
+        bucket = reqs[0].bucket
+        bname = f"{bucket[0]}x{bucket[1]}" if bucket is not None else None
+        occupancy = f"{len(reqs)}/{B}"
+        service_s = t_done - t_start
+        for pos, r in enumerate(reqs):
+            ctx = r.trace
+            if ctx is None or not ctx.sampled:
+                continue
+            req_sid = tracer.record(
+                ctx, "engine/request", t_done - r.t_enqueue,
+                attrs={"rid": r.rid,
+                       "peers": [i for i in rids if i != r.rid],
+                       "queue_pos": pos,
+                       "queue_wait_ms": round(
+                           (t_start - r.t_enqueue) * 1e3, 3),
+                       "pad_frac": round(pad / B, 4),
+                       "bucket": bname, "occupancy": occupancy,
+                       "stream": r.stream,
+                       "generation": self.generation})
+            if req_sid is None:
+                continue
+            disp_sid = tracer.record(
+                TraceContext(ctx.trace_id, req_sid), "engine/dispatch",
+                service_s, attrs={"batch_rids": rids, "pad": pad,
+                                  "bucket": bname, "occupancy": occupancy})
+            if disp_sid is None or not phases:
+                continue
+            pctx = TraceContext(ctx.trace_id, disp_sid)
+            for ph in ("h2d", "forward", "readback", "postprocess"):
+                d = phases.get(ph)
+                if d is not None:
+                    tracer.record(pctx, f"engine/{ph}", d)
 
     def _note_first_dispatch(self, shape, kind: str, tel) -> bool:
         """First-seen accounting for one batch's program (registry when
@@ -817,23 +894,33 @@ class ServeEngine:
         return first
 
     def _forward_legacy(self, reqs: List[_Request], images, im_info,
-                        tel) -> dict:
+                        tel, phases: Optional[dict] = None) -> dict:
         """PR-3 path: host-prepped batch in, full score/delta readback,
         host decode + per-class NMS.  Returns the batch's boundary-
         crossing counter increments (two h2d arrays — images and im_info
         ship separately into the jit call — one dispatch, one fat
-        readback)."""
+        readback).  ``phases`` (tracing on only) collects per-phase wall
+        durations for the engine's dispatch sub-spans."""
         import jax
 
         shape = tuple(images.shape)
         first = self._note_first_dispatch(shape, "serve_predict", tel)
         t_fwd = time.monotonic()
+        t_ph = time.perf_counter() if phases is not None else 0.0
         with tel.span("serve/forward"):
             rois, roi_valid, cls_prob, bbox_deltas, _ = \
                 self.predictor.predict(images, im_info)
+        if phases is not None:
+            t_now = time.perf_counter()
+            phases["forward"] = t_now - t_ph
+            t_ph = t_now
         with tel.span("serve/readback"):
             rois, roi_valid, cls_prob, bbox_deltas = jax.device_get(
                 (rois, roi_valid, cls_prob, bbox_deltas))
+        if phases is not None:
+            t_now = time.perf_counter()
+            phases["readback"] = t_now - t_ph
+            t_ph = t_now
         if first and self.registry is not None:
             # first dispatch of a shape = its compile: the forward +
             # readback wall is the compile(+first run) cost this program
@@ -850,13 +937,15 @@ class ServeEngine:
                                         cfg.TEST.NMS,
                                         cfg.TEST.MAX_PER_IMAGE)
                 r.future._set_result(detections_to_records(dets_pc))
+        if phases is not None:
+            phases["postprocess"] = time.perf_counter() - t_ph
         nbytes = int(sum(np.asarray(a).nbytes for a in
                          (rois, roi_valid, cls_prob, bbox_deltas)))
         return {"h2d_transfers": 2, "dispatches": 1, "readbacks": 1,
                 "readback_bytes": nbytes}
 
     def _forward_e2e(self, reqs: List[_Request], staged, im_info,
-                     tel) -> dict:
+                     tel, phases: Optional[dict] = None) -> dict:
         """Single-dispatch path (``--serve-e2e``): ONE ``device_put`` of
         the staged uint8 batch + its sidecars, ONE fused
         prep → forward → decode+NMS dispatch (registry kind
@@ -880,15 +969,28 @@ class ServeEngine:
         shape = tuple(staged.shape) + (f"mpi={mpi}", f"th={th:g}")
         first = self._note_first_dispatch(shape, "serve_e2e", tel)
         t_fwd = time.monotonic()
+        t_ph = time.perf_counter() if phases is not None else 0.0
         with tel.span("serve/h2d"):
             # the one host→device transfer: a single put of the argument
             # tuple whose only large buffer is the staged uint8 batch
             args = jax.device_put((staged, raw_hw, ratio,
                                    np.asarray(im_info, np.float32), flip))
+        if phases is not None:
+            t_now = time.perf_counter()
+            phases["h2d"] = t_now - t_ph
+            t_ph = t_now
         with tel.span("serve/forward"):
             dets, dvalid = self.predictor.predict_serve_e2e(*args, mpi, th)
+        if phases is not None:
+            t_now = time.perf_counter()
+            phases["forward"] = t_now - t_ph
+            t_ph = t_now
         with tel.span("serve/readback"):
             dets, dvalid = jax.device_get((dets, dvalid))
+        if phases is not None:
+            t_now = time.perf_counter()
+            phases["readback"] = t_now - t_ph
+            t_ph = t_now
         if first and self.registry is not None:
             self.predictor.record_compile_seconds(
                 shape, time.monotonic() - t_fwd, kind="serve_e2e")
@@ -897,6 +999,8 @@ class ServeEngine:
                 dets_pc = device_dets_to_per_class(dets[b], dvalid[b],
                                                    cfg.NUM_CLASSES)
                 r.future._set_result(detections_to_records(dets_pc))
+        if phases is not None:
+            phases["postprocess"] = time.perf_counter() - t_ph
         nbytes = int(np.asarray(dets).nbytes + np.asarray(dvalid).nbytes)
         return {"h2d_transfers": 1, "dispatches": 1, "readbacks": 1,
                 "readback_bytes": nbytes}
@@ -939,6 +1043,9 @@ class ServeEngine:
         out["dtype"] = self._dtype
         if self.capture.enabled:
             out["flywheel"] = self.capture.metrics()
+        tracer = tracectx.get()
+        if tracer.enabled:
+            out["trace"] = tracer.metrics()
         if self.stream is not None:
             out["stream"] = self.stream.metrics()
         if self.registry is not None:

@@ -57,8 +57,10 @@ re-runs the same scenarios over real localhost TCP subprocesses.
 
 from __future__ import annotations
 
+import inspect
 import json
 import queue
+import re
 import threading
 import time
 from dataclasses import dataclass
@@ -72,7 +74,15 @@ from mx_rcnn_tpu.serve.frontend import (_Handler, _TCPHTTPServer,
 from mx_rcnn_tpu.serve.supervisor import (FAILED, READY as SUP_READY,
                                           STOPPED, ReplicaSupervisor,
                                           TokenBucket)
+from mx_rcnn_tpu.telemetry import tracectx
 from mx_rcnn_tpu.telemetry.obs import PROM_CONTENT_TYPE, prometheus_text
+from mx_rcnn_tpu.telemetry.tracectx import (NULL_SPAN, TRACE_HEADER,
+                                            TraceContext)
+
+# client-minted trace ids arrive as a ``"trace"`` doc field INSIDE the
+# opaque forwarded body; with tracing on the router sniffs it without
+# paying a full JSON decode of the base64 image payload
+_TRACE_BODY_RE = re.compile(rb'"trace"\s*:\s*"([0-9a-fA-F\-]{8,80})"')
 
 # remote-member states — the PR-8 replica states with respawn authority
 # amputated: a fabric can only evict and re-admit, never fork
@@ -213,9 +223,10 @@ class RemoteMember:
     def is_ready(self) -> bool:
         return self.state == MEMBER_READY
 
-    def http_raw(self, method, path, body=None, timeout=60.0):
+    def http_raw(self, method, path, body=None, timeout=60.0,
+                 headers=None):
         return address_request_raw(self.address, method, path, body=body,
-                                   timeout=timeout)
+                                   timeout=timeout, headers=headers)
 
     def http(self, method, path, doc=None, timeout=60.0):
         return address_request(self.address, method, path, doc=doc,
@@ -293,9 +304,10 @@ class LocalMember:
     def is_ready(self) -> bool:
         return self.state == SUP_READY
 
-    def http_raw(self, method, path, body=None, timeout=60.0):
+    def http_raw(self, method, path, body=None, timeout=60.0,
+                 headers=None):
         return address_request_raw(self.address, method, path, body=body,
-                                   timeout=timeout)
+                                   timeout=timeout, headers=headers)
 
     def http(self, method, path, doc=None, timeout=60.0):
         return address_request(self.address, method, path, doc=doc,
@@ -800,14 +812,25 @@ class FabricRouter:
         self.timeout_s = (pool.opts.forward_timeout_s
                           if timeout_s is None else timeout_s)
         self._forward = forward_fn or self._default_forward
+        # trace-context propagation needs a headers kwarg on the forward
+        # fn; injected test doubles keep the original 5-arg signature, so
+        # sniff once here instead of TypeError-ing per request
+        try:
+            self._fwd_headers = ("headers"
+                                 in inspect.signature(
+                                     self._forward).parameters)
+        except (TypeError, ValueError):
+            self._fwd_headers = False
         self._rr = 0
         self._rr_lock = threading.Lock()
         self.retry_bucket = TokenBucket(pool.opts.retry_budget,
                                         pool.opts.retry_refill_per_s)
 
     @staticmethod
-    def _default_forward(member, method, path, body, timeout):
-        return member.http_raw(method, path, body=body, timeout=timeout)
+    def _default_forward(member, method, path, body, timeout,
+                         headers=None):
+        return member.http_raw(method, path, body=body, timeout=timeout,
+                               headers=headers)
 
     def _pick(self, exclude=(), now: Optional[float] = None):
         """Least-loaded over FRESH queue_depth samples; round-robin over
@@ -844,43 +867,78 @@ class FabricRouter:
                 group.remove(m)
         return None
 
-    def route_predict(self, body: bytes) -> tuple:
+    def route_predict(self, body: bytes,
+                      trace_header: Optional[str] = None) -> tuple:
         """One client request → (status, body_bytes, ctype): least-loaded
         pick (hedged past ``hedge_after_ms``), then the PR-8 retry-once-
-        on-alternate under the token-bucket budget."""
+        on-alternate under the token-bucket budget.
+
+        With tracing on, the whole routing decision is one
+        ``fabric/route`` span — pick, hedge, retry, breaker outcomes as
+        attrs — and the context is forwarded to the member via
+        ``X-Mxr-Trace`` (the member's frontend span chains under it).
+        Context comes from the client's header, a ``"trace"`` doc field
+        sniffed from the opaque body, or a fresh mint; tracing off skips
+        all of it."""
+        tracer = tracectx.get()
+        if not tracer.enabled:
+            return self._route_predict(body, None, NULL_SPAN)
+        raw_t = trace_header
+        if not raw_t and body:
+            match = _TRACE_BODY_RE.search(body)
+            if match:
+                raw_t = match.group(1).decode("ascii")
+        ctx = ((TraceContext.parse(raw_t) if raw_t else None)
+               or tracer.mint())
+        with tracer.span(ctx, "fabric/route") as sp:
+            headers = ({TRACE_HEADER: sp.ctx.to_header()}
+                       if sp.ctx is not None else None)
+            status, raw, ctype = self._route_predict(body, headers, sp)
+            sp.set(status=status if status is not None else 0)
+        return status, raw, ctype
+
+    def _route_predict(self, body: bytes, headers: Optional[dict],
+                       sp) -> tuple:
         pool = self.pool
         m = self._pick()
         if m is None:
             pool.count("no_ready")
+            sp.set(shed=True)
             return self._shed(f"no routable members "
                               f"(0/{len(pool.members)} reachable) — "
                               f"retry with backoff")
+        sp.set(member=m.name)
         status, raw, ctype, transport_err, hedge = \
-            self._attempt_hedged(m, body)
+            self._attempt_hedged(m, body, headers, sp)
         if transport_err is None and status != 503:
             return status, raw, ctype
         if not self.retry_bucket.take():
             pool.count("retry_budget_exhausted")
+            sp.set(shed=True, error=transport_err)
             return self._shed("member failed and the retry budget is "
                               "exhausted — retry with backoff")
         pool.count("retry")
+        sp.set(retried=True)
         exclude = (m, hedge) if hedge is not None else (m,)
         m2 = self._pick(exclude=exclude)
         if m2 is None:
             if transport_err is not None:
+                sp.set(shed=True, error=transport_err)
                 return self._shed(f"member {m.name} failed "
                                   f"({transport_err}) and no alternate "
                                   f"is routable — retry with backoff")
             return status, raw, ctype  # lone member's own 503 stands
-        status2, raw2, ctype2, err2 = self._forward_to(m2, body)
+        sp.set(retry_member=m2.name)
+        status2, raw2, ctype2, err2 = self._forward_to(m2, body, headers)
         if err2 is None:
             pool.count("retry_ok")
             return status2, raw2, ctype2
+        sp.set(error=f"{transport_err or status}; then {err2}")
         return 502, json.dumps(
             {"error": f"members failed: {transport_err or status}; "
                       f"then {err2}"}).encode(), "application/json"
 
-    def _attempt_hedged(self, m, body):
+    def _attempt_hedged(self, m, body, headers=None, sp=NULL_SPAN):
         """First attempt, with the tail hedge: past ``hedge_after_ms``
         the request is duplicated to a second member and the first 2xx
         wins.  Returns (status, raw, ctype, transport_err, hedge_member).
@@ -888,11 +946,12 @@ class FabricRouter:
         from retries, which answer failures."""
         hedge_s = self.pool.opts.hedge_after_ms / 1e3
         if hedge_s <= 0:
-            return self._forward_to(m, body) + (None,)
+            return self._forward_to(m, body, headers) + (None,)
         results: "queue.Queue" = queue.Queue()
 
         def run(member):
-            results.put((member,) + self._forward_to(member, body))
+            results.put((member,)
+                        + self._forward_to(member, body, headers))
 
         threading.Thread(target=run, args=(m,), daemon=True,
                          name="fabric-fwd").start()
@@ -906,6 +965,7 @@ class FabricRouter:
         if m2 is None:  # nobody to hedge to: wait the primary out
             return results.get(timeout=self.timeout_s + 10.0)[1:] + (None,)
         self.pool.count("hedge_fired")
+        sp.set(hedged=True, hedge_member=m2.name)
         threading.Thread(target=run, args=(m2,), daemon=True,
                          name="fabric-hedge").start()
         def won(r):  # (member, status, raw, ctype, transport_err)
@@ -919,9 +979,10 @@ class FabricRouter:
                 winner = other
         if winner[0] is m2:
             self.pool.count("hedge_won")
+            sp.set(hedge_won=True)
         return winner[1:] + (m2,)
 
-    def _forward_to(self, m, body):
+    def _forward_to(self, m, body, headers=None):
         """(status, raw, ctype, transport_error) — in-flight counted for
         reload drains, outcome recorded on the member's breaker."""
         pool = self.pool
@@ -933,8 +994,13 @@ class FabricRouter:
             m.requests += 1
         pool.counters["requests"] += 1
         try:
-            status, raw, ctype = self._forward(m, "POST", "/predict",
-                                               body, self.timeout_s)
+            if self._fwd_headers and headers:
+                status, raw, ctype = self._forward(
+                    m, "POST", "/predict", body, self.timeout_s,
+                    headers=headers)
+            else:
+                status, raw, ctype = self._forward(m, "POST", "/predict",
+                                                   body, self.timeout_s)
         except Exception as e:  # noqa: BLE001 — dead/hung/reset member
             pool.count("transport_error")
             pool.note_suspect(m)
@@ -982,6 +1048,9 @@ class FabricRouter:
         out["engines"] = per
         out["aggregate_counters"] = agg
         out["generation"] = self.pool.generation
+        tracer = tracectx.get()
+        if tracer.enabled:
+            out["trace"] = tracer.metrics()
         return out
 
 
@@ -1011,6 +1080,13 @@ def fabric_prometheus(router: FabricRouter) -> str:
                     _point_gauge(m.depth)
                 gauges[f"fabric/queue_depth_age_s/{m.name}"] = \
                     _point_gauge(round(now - m.depth_t, 3))
+    tracer = tracectx.get()
+    if tracer.enabled:
+        for key, v in tracer.metrics().items():
+            if key in ("spans_emitted", "spans_dropped", "tail_kept"):
+                counters[f"trace/{key}"] = v
+            elif isinstance(v, (int, float)):
+                gauges[f"trace/{key}"] = _point_gauge(v)
     rank = telemetry.get().rank
     return prometheus_text({rank: {"counters": counters,
                                    "gauges": gauges}})
@@ -1052,7 +1128,8 @@ class _FabricHandler(_Handler):
         if self.path == "/predict":
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
-            status, raw, ctype = self.router.route_predict(body)
+            status, raw, ctype = self.router.route_predict(
+                body, trace_header=self.headers.get(TRACE_HEADER))
             self._reply_raw(status, raw, ctype or "application/json")
             return
         try:

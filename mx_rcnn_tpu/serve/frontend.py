@@ -67,8 +67,10 @@ from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.serve.engine import (DeadlineExceededError, RejectedError,
                                       ServeEngine)
 from mx_rcnn_tpu.serve.stream import StaleSeqError, StreamManager
+from mx_rcnn_tpu.telemetry import tracectx
 from mx_rcnn_tpu.telemetry.obs import (PROM_CONTENT_TYPE, pool_prometheus,
                                        serve_prometheus)
+from mx_rcnn_tpu.telemetry.tracectx import TRACE_HEADER, TraceContext
 
 # result-wait ceiling for one HTTP request; the engine's own per-request
 # deadline (default ServeOptions.deadline_ms) fires long before this —
@@ -108,15 +110,14 @@ def encode_image_payload(img: np.ndarray) -> dict:
             "data": base64.b64encode(img.tobytes()).decode("ascii")}
 
 
-def handle_request_doc(engine: ServeEngine, doc: dict) -> tuple:
-    """One predict request → (http_status, response_doc).  Shared by all
-    three transports so their status semantics cannot drift."""
+def _predict_doc(engine: ServeEngine, doc: dict, img,
+                 trace) -> tuple:
+    """The submit+wait core of one predict request — trace-agnostic, so
+    the traced and untraced paths produce IDENTICAL response docs (the
+    tracing-off byte-parity contract)."""
     try:
-        img = decode_image_payload(doc)
-    except (ValueError, TypeError, KeyError) as e:
-        return 400, {"error": str(e)}
-    try:
-        fut = engine.submit(img, deadline_ms=doc.get("deadline_ms"))
+        fut = engine.submit(img, deadline_ms=doc.get("deadline_ms"),
+                            trace=trace)
         dets = fut.result(timeout=WAIT_TIMEOUT_S)
     except RejectedError as e:
         return 503, {"error": str(e)}
@@ -131,11 +132,51 @@ def handle_request_doc(engine: ServeEngine, doc: dict) -> tuple:
     return 200, {"detections": dets, "queue_wait_ms": round(qms, 3)}
 
 
-def submit_stream_frame(stream: StreamManager, doc: dict) -> tuple:
+def handle_request_doc(engine: ServeEngine, doc: dict,
+                       trace_header: Optional[str] = None) -> tuple:
+    """One predict request → (http_status, response_doc).  Shared by all
+    three transports so their status semantics cannot drift.
+
+    Trace context comes from the forwarded ``X-Mxr-Trace`` header (the
+    router's chain wins — it carries the parent span) or the ``"trace"``
+    doc field (a client-minted bare trace id); with tracing enabled and
+    neither present, one is minted here — the frontend is the root of
+    the hop tree either way.  The trace id is echoed back as a
+    ``"trace"`` response key ONLY when the client sent one or tracing is
+    on, so a tracing-off ``/predict`` stays byte-for-byte."""
+    try:
+        img = decode_image_payload(doc)
+    except (ValueError, TypeError, KeyError) as e:
+        return 400, {"error": str(e)}
+    tracer = tracectx.get()
+    raw = trace_header or doc.get("trace")
+    if not tracer.enabled:
+        status, resp = _predict_doc(engine, doc, img, None)
+        if raw:
+            # propagation without recording: a client that minted an id
+            # still gets it echoed so cross-host correlation never
+            # depends on which members have tracing on
+            resp["trace"] = str(raw).split("-", 1)[0]
+        return status, resp
+    ctx = (TraceContext.parse(raw) if raw else None) or tracer.mint()
+    with tracer.span(ctx, "frontend/predict") as sp:
+        status, resp = _predict_doc(engine, doc, img, sp.ctx)
+        sp.set(status=status)
+    resp["trace"] = ctx.trace_id
+    return status, resp
+
+
+def submit_stream_frame(stream: StreamManager, doc: dict,
+                        trace_header: Optional[str] = None) -> tuple:
     """Validate + submit one stream frame WITHOUT waiting — the submit
     half of the pipelined ``/stream`` handler.  Returns
     ``(None, None, FrameResult)`` on acceptance or
-    ``(status, error_doc, None)`` on submit-side failure."""
+    ``(status, error_doc, None)`` on submit-side failure.
+
+    Tracing mirrors ``/predict``: per-frame ``"trace"`` doc field (or the
+    body's forwarded header) is accepted, else one is minted when tracing
+    is on; a ``frontend/frame`` span covers the gate+submit and parents
+    the stream-gate / engine spans below it."""
     sid, seq = doc.get("stream_id"), doc.get("seq")
     if not isinstance(sid, str) or not sid:
         return 400, {"error": "frame needs a non-empty string "
@@ -147,9 +188,17 @@ def submit_stream_frame(stream: StreamManager, doc: dict) -> tuple:
         img = decode_image_payload(doc)
     except (ValueError, TypeError, KeyError) as e:
         return 400, {"error": str(e), "stream_id": sid, "seq": seq}, None
+    tracer = tracectx.get()
+    sp = tracectx.NULL_SPAN
+    if tracer.enabled:
+        raw = doc.get("trace") or trace_header
+        ctx = (TraceContext.parse(raw) if raw else None) or tracer.mint()
+        sp = tracer.span(ctx, "frontend/frame", stream=sid, seq=seq)
     try:
-        res = stream.submit_frame(sid, seq, img,
-                                  deadline_ms=doc.get("deadline_ms"))
+        with sp:
+            res = stream.submit_frame(sid, seq, img,
+                                      deadline_ms=doc.get("deadline_ms"),
+                                      trace=sp.ctx)
     except StaleSeqError as e:
         return 409, {"error": str(e), "stream_id": sid, "seq": seq}, None
     except RejectedError as e:
@@ -184,17 +233,20 @@ def resolve_stream_frame(res) -> tuple:
     return 200, out
 
 
-def handle_stream_doc(stream: StreamManager, doc: dict) -> tuple:
+def handle_stream_doc(stream: StreamManager, doc: dict,
+                      trace_header: Optional[str] = None) -> tuple:
     """One frame, submit + wait → (status, response_doc).  The stdio
     transport's unit; HTTP goes through :func:`handle_stream_lines` to
     pipeline multi-frame bodies."""
-    status, err, res = submit_stream_frame(stream, doc)
+    status, err, res = submit_stream_frame(stream, doc,
+                                           trace_header=trace_header)
     if res is None:
         return status, err
     return resolve_stream_frame(res)
 
 
-def handle_stream_lines(stream: StreamManager, lines) -> list:
+def handle_stream_lines(stream: StreamManager, lines,
+                        trace_header: Optional[str] = None) -> list:
     """NDJSON body → list of (status, doc) replies in input order.
     Submits EVERY frame before resolving any, so a single connection's
     burst coalesces into shared batches instead of serializing."""
@@ -208,7 +260,8 @@ def handle_stream_lines(stream: StreamManager, lines) -> list:
         except json.JSONDecodeError as e:
             staged.append((400, {"error": f"bad JSON line: {e}"}, None))
             continue
-        staged.append(submit_stream_frame(stream, doc))
+        staged.append(submit_stream_frame(stream, doc,
+                                          trace_header=trace_header))
     return [(status, err) if res is None else resolve_stream_frame(res)
             for status, err, res in staged]
 
@@ -352,7 +405,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, {"error": f"bad Content-Length: {e}"})
                 return
             replies = handle_stream_lines(
-                stream, body.decode("utf-8", "replace").splitlines())
+                stream, body.decode("utf-8", "replace").splitlines(),
+                trace_header=self.headers.get(TRACE_HEADER))
             payload = "".join(json.dumps({"status": s, **d}) + "\n"
                               for s, d in replies)
             self._reply_raw(200, payload.encode(), "application/x-ndjson")
@@ -375,7 +429,8 @@ class _Handler(BaseHTTPRequestHandler):
             if self.request_hook is not None:
                 self.request_hook(err[0])
             return
-        status, resp = handle_request_doc(engine, doc)
+        status, resp = handle_request_doc(
+            engine, doc, trace_header=self.headers.get(TRACE_HEADER))
         self._reply(status, resp)
         if self.request_hook is not None:
             self.request_hook(status)

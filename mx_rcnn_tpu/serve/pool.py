@@ -48,6 +48,7 @@ from typing import Dict, List, Optional
 
 from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.telemetry import tracectx
 
 
 def param_nbytes(tree) -> int:
@@ -378,14 +379,30 @@ class ModelPool:
             if batch is None:
                 # raced with a sweep/policy change; re-judge immediately
                 continue
+            tracer = tracectx.get()
+            t_sched = time.perf_counter() if tracer.enabled else 0.0
             self.ensure_resident(e.model_id)
             with self._lock:
-                if self._last_model not in (None, e.model_id):
+                switched = self._last_model not in (None, e.model_id)
+                if switched:
                     self.counters["sched_switches"] += 1
                 self._last_model = e.model_id
                 e.last_sched = now
                 e.batches += 1
                 self.counters["sched_batches"] += 1
+            if tracer.enabled:
+                # pool/sched span per traced request in the claimed
+                # batch: which model the interleaver picked, whether the
+                # pick switched programs, and what residency paging cost
+                # the batch paid before its dispatch
+                sched_s = time.perf_counter() - t_sched
+                for r in batch:
+                    ctx = r.trace
+                    if ctx is not None and ctx.sampled:
+                        tracer.record(ctx, "pool/sched", sched_s,
+                                      attrs={"model": e.model_id,
+                                             "switched": switched,
+                                             "batch": len(batch)})
             e.engine.dispatch_batch(batch)
 
     # -- introspection ---------------------------------------------------

@@ -72,6 +72,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.telemetry import tracectx
 from mx_rcnn_tpu.data.loader import prepare_image
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.serve.frontend import make_server
@@ -541,6 +542,9 @@ def serve_replica(engine, cfg, sock_path: Optional[str] = None,
     the member with a fabric router at that address once warm,
     advertising ``advertise`` (default ``host:port``)."""
     predictor = predictor if predictor is not None else engine.predictor
+    # subprocess members inherit tracing opt-in via MXR_TRACE_DIR — a
+    # no-op when the env is absent or the parent already configured one
+    tracectx.configure_from_env(member=f"member{index}", rank=index)
     faults = ReplicaFaults(index)
     net = NetFaults(index)
     reloader = make_reloader(engine, predictor, cfg,

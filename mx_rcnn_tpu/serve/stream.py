@@ -50,7 +50,7 @@ from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.data.image import stage_raw_to_bucket
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.serve.engine import RejectedError, ServeEngine
-from mx_rcnn_tpu.telemetry import Hist
+from mx_rcnn_tpu.telemetry import Hist, tracectx
 
 KIND = "frame_delta"
 
@@ -252,11 +252,15 @@ class StreamManager:
             return st
 
     def submit_frame(self, stream_id: str, seq: int, image: np.ndarray,
-                     deadline_ms: Optional[float] = None) -> FrameResult:
+                     deadline_ms: Optional[float] = None,
+                     trace=None) -> FrameResult:
         """One sequenced frame → :class:`FrameResult`.  Raises
         :class:`StaleSeqError` on a non-increasing ``seq`` and lets the
         engine's :class:`RejectedError`/deadline semantics pass through
-        unchanged — a stream frame is an ordinary request plus state."""
+        unchanged — a stream frame is an ordinary request plus state.
+        ``trace`` (a TraceContext) records the skip-vs-forward verdict as
+        a ``stream/gate`` span and rides forwarded frames into the
+        engine's batch-causality spans; None (the default) is inert."""
         tel = telemetry.get()
         state = self._state(stream_id)
         with state.lock:
@@ -273,10 +277,10 @@ class StreamManager:
                 self.counters["frames"] += 1
             tel.counter("stream/frames")
             return self._gate_and_submit(state, seq, image, deadline_ms,
-                                         tel)
+                                         tel, trace)
 
     def _gate_and_submit(self, state: _StreamState, seq: int, image,
-                         deadline_ms, tel) -> FrameResult:
+                         deadline_ms, tel, trace=None) -> FrameResult:
         t0 = time.perf_counter()
         key = cur_dev = staged = None
         delta = None
@@ -319,13 +323,27 @@ class StreamManager:
                     dt = time.perf_counter() - t0
                     self.hists["stream/skip_time"].observe(dt)
                     tel.observe("stream/skip_time", dt)
+                    if trace is not None:
+                        tracectx.get().record(
+                            trace, "stream/gate", dt,
+                            attrs={"skipped": True,
+                                   "delta": round(delta, 4),
+                                   "skip_run": state.skip_run,
+                                   "stream": state.stream_id})
                     return FrameResult(state.stream_id, seq, True, delta,
                                        state.ref_future)
+        if trace is not None:
+            tracectx.get().record(
+                trace, "stream/gate", time.perf_counter() - t0,
+                attrs={"skipped": False,
+                       "delta": round(delta, 4) if delta is not None
+                       else None,
+                       "stream": state.stream_id})
         # full path: an ordinary engine request, tagged with its stream
         # so the dispatcher's flush bookkeeping can count cross-stream
         # batch sharing
         fut = self.engine.submit(image, deadline_ms=deadline_ms,
-                                 stream=state.stream_id)
+                                 stream=state.stream_id, trace=trace)
         state.ref_future = fut
         state.generation = self.engine.generation
         state.skip_run = 0

@@ -43,6 +43,7 @@ from typing import Optional
 
 from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.telemetry import tracectx
 
 SNAPSHOT_INTERVAL_S = 2.0
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -408,6 +409,18 @@ def engine_summary(engine) -> dict:
         if isinstance(v, (int, float)):
             gauges[f"stream/{key}"] = {
                 "count": 1, "mean": v, "min": v, "max": v, "last": v}
+    # distributed-tracing counters (tracectx, attached when tracing is
+    # on): spans emitted/dropped and tail-kept trees as trace/* —
+    # rendered as mxr_trace_* by the Prometheus exposition, same
+    # one-metrics-path contract as the flywheel/stream folds above
+    tracer = tracectx.get()
+    if tracer.enabled:
+        for key, v in tracer.metrics().items():
+            if key in ("spans_emitted", "spans_dropped", "tail_kept"):
+                counters[f"trace/{key}"] = v
+            elif isinstance(v, (int, float)):
+                gauges[f"trace/{key}"] = {
+                    "count": 1, "mean": v, "min": v, "max": v, "last": v}
     gen = m.get("generation", 0)
     gauges.setdefault("serve/generation", {
         "count": 1, "mean": gen, "min": gen, "max": gen, "last": gen})

@@ -120,17 +120,34 @@ STREAM_COUNTERS = (
     "stream/coalesced_batches",
 )
 
+# distributed request tracing (telemetry/tracectx.py): rendered as its
+# own section — zeros included — whenever the stream carries any
+# trace/* counter, so "did spans actually emit, and were the slow trees
+# tail-kept?" is one greppable block (script/trace_smoke.sh reads it)
+TRACE_COUNTERS = (
+    "trace/spans_emitted",
+    "trace/spans_dropped",
+    "trace/tail_kept",
+)
+
 
 def event_files(paths: Iterable[str]) -> List[str]:
-    """Expand run dirs to their per-rank event files; pass files through."""
+    """Expand run dirs to their per-rank event files; pass files through.
+
+    Distributed-trace span streams (``spans_<member>.jsonl``,
+    telemetry/tracectx.py) fold alongside the per-rank files: same JSONL
+    schema, ``kind: "span"`` records whose additive trace fields old
+    readers ignore — so ``--trace`` output gains per-member hop tracks
+    and the span table counts cross-hop work with zero extra plumbing."""
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
             found = sorted(glob.glob(os.path.join(p, "events_rank*.jsonl")))
+            found += sorted(glob.glob(os.path.join(p, "spans_*.jsonl")))
             if not found:
                 raise FileNotFoundError(
-                    f"no events_rank*.jsonl under {p} — was the run started "
-                    f"with --telemetry-dir?")
+                    f"no events_rank*.jsonl or spans_*.jsonl under {p} — "
+                    f"was the run started with --telemetry-dir?")
             out.extend(found)
         else:
             out.append(p)
@@ -268,6 +285,7 @@ def render_table(summary: dict) -> str:
         k.startswith("stream/") for k in summary.get("gauges", {}))
     pool = any(k in POOL_COUNTERS or k.startswith("serve/weight_page")
                or k.startswith("serve/sched_") for k in counters)
+    tracing = any(k.startswith("trace/") for k in counters)
     pool_extra = sorted(
         n for n in counters if n not in POOL_COUNTERS
         and (n.startswith("serve/weight_page_in/")
@@ -294,6 +312,8 @@ def render_table(summary: dict) -> str:
                 continue  # ditto the streaming table
             if pool and (name in POOL_COUNTERS or name in pool_extra):
                 continue  # ditto the model-pool table
+            if tracing and name in TRACE_COUNTERS:
+                continue  # ditto the tracing table
             lines.append(f"{name:<34}{v:>8}")
         lines.append("")
         lines.append(f"{'recovery event':<34}{'total':>8}")
@@ -327,6 +347,11 @@ def render_table(summary: dict) -> str:
             for name in POOL_COUNTERS:
                 lines.append(f"{name:<34}{counters.get(name, 0):>8}")
             for name in pool_extra:  # per-model paging counters
+                lines.append(f"{name:<34}{counters.get(name, 0):>8}")
+        if tracing:
+            lines.append("")
+            lines.append(f"{'tracing':<34}{'total':>8}")
+            for name in TRACE_COUNTERS:
                 lines.append(f"{name:<34}{counters.get(name, 0):>8}")
     gauges = summary.get("gauges", {})
     if gauges:

@@ -19,7 +19,15 @@ stream into a browsable timeline: open the file in Perfetto
   cumulative total, gauges the sampled value;
 * meta events (``flight_trigger``, ``nan_detected``, ``recompile`` ...)
   → instant events (``ph: "i"``) so the crash markers are visible on the
-  timeline.
+  timeline;
+* **distributed-trace spans** (``tracectx`` records carrying a
+  ``trace`` id) → the same complete events, but grouped under one
+  process per fabric MEMBER (``spans_<member>.jsonl`` files fold
+  side-by-side) with the span attrs in ``args`` — one track per hop
+  (``fabric``/``frontend``/``engine``/``pool``/``stream`` prefixes) —
+  plus flow arrows (``ph: "s"``/``"t"``) linking every span of one trace
+  id across members, so a request's path through the fabric reads as a
+  connected chain on the timeline.
 
 Timestamps are microseconds relative to the earliest event in the fold
 (absolute unix µs blows up the Perfetto axis).  Stdlib only — no jax.
@@ -67,12 +75,22 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
     out: List[dict] = []
     tids: dict = {}       # (pid, track_name) -> tid
     cum: dict = {}        # (pid, counter_name) -> running total
+    member_pids: dict = {}  # member label -> synthetic pid
+    flows: dict = {}      # trace id -> [span X event] (flow-arrow links)
 
     def tid_for(pid: int, track: str) -> int:
         key = (pid, track)
         if key not in tids:
             tids[key] = len([1 for (p, _) in tids if p == pid]) + 1
         return tids[key]
+
+    def pid_for_member(member: str) -> int:
+        # trace spans group per fabric member, not per rank — members
+        # from different hosts land in side-by-side process groups with
+        # no pid collision against the rank pids (offset well clear)
+        if member not in member_pids:
+            member_pids[member] = 1000 + len(member_pids)
+        return member_pids[member]
 
     for e in sorted(events, key=lambda e: e.get("t", 0.0)):
         pid = int(e.get("rank", 0))
@@ -82,6 +100,9 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
             start = _span_start(e)
             if start is None:
                 continue
+            trace_id = e.get("trace")
+            if trace_id is not None and e.get("member") is not None:
+                pid = pid_for_member(str(e["member"]))
             ev = {"name": name, "ph": "X", "pid": pid,
                   "tid": tid_for(pid, _track(name)),
                   "ts": round((start - t0) * 1e6, 3),
@@ -89,6 +110,13 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
             n = e.get("n", 1)
             if n != 1:  # one record standing for n dispatches
                 ev["args"] = {"n": n}
+            if trace_id is not None:
+                args = {"trace": trace_id, "sid": e.get("sid")}
+                if e.get("psid") is not None:
+                    args["psid"] = e["psid"]
+                args.update(e.get("attrs") or {})
+                ev["args"] = args
+                flows.setdefault(str(trace_id), []).append(ev)
             out.append(ev)
         elif kind == "counter":
             ckey = (pid, name)
@@ -106,10 +134,33 @@ def trace_events(events: Iterable[dict]) -> List[dict]:
                         "ts": round((float(e["t"]) - t0) * 1e6, 3),
                         "args": dict(e.get("fields") or {})})
 
-    for pid in sorted({p for (p, _) in tids} | {int(e.get("rank", 0))
-                                                for e in events}):
+    # flow arrows: one chain per trace id, start (ph "s") at the
+    # earliest span, steps (ph "t") through every later one — binding
+    # ts sits just inside each slice so Perfetto attaches the arrow to
+    # the span, not the track
+    for trace_id, evs in sorted(flows.items()):
+        if len(evs) < 2:
+            continue
+        try:
+            flow_id = int(trace_id[:15], 16)
+        except ValueError:
+            flow_id = abs(hash(trace_id)) % (1 << 60)
+        evs.sort(key=lambda ev: ev["ts"])
+        for i, ev in enumerate(evs):
+            out.append({"name": "trace", "cat": "trace",
+                        "ph": "s" if i == 0 else "t", "id": flow_id,
+                        "pid": ev["pid"], "tid": ev["tid"],
+                        "ts": round(ev["ts"]
+                                    + min(ev["dur"] / 2, 0.5), 3)})
+    rank_pids = sorted({p for (p, _) in tids
+                        if p not in member_pids.values()}
+                       | {int(e.get("rank", 0)) for e in events})
+    for pid in rank_pids:
         out.append({"name": "process_name", "ph": "M", "pid": pid,
                     "args": {"name": f"rank {pid}"}})
+    for member, pid in sorted(member_pids.items()):
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": f"member {member}"}})
     for (pid, track), tid in sorted(tids.items()):
         out.append({"name": "thread_name", "ph": "M", "pid": pid,
                     "tid": tid, "args": {"name": track}})

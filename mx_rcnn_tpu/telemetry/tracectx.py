@@ -1,0 +1,460 @@
+"""Distributed request tracing: cross-hop trace context over the
+telemetry substrate (ISSUE 16 tentpole).
+
+The serving plane is distributed — fabric routing with hedges and
+breakers, pool scheduling, stream gates — but PR-1/5/6 observability
+stops at per-process aggregates: histograms say *that* p99 degraded and
+nothing can say *why* for any single request.  This module adds the
+request-scoped layer:
+
+* :class:`TraceContext` — a 128-bit trace id + 64-bit parent span id +
+  sampled flag, minted at whichever frontend first sees the request (or
+  accepted from an ``X-Mxr-Trace`` header / ``"trace"`` doc field) and
+  propagated through every hop: fabric router pick/hedge/retry/breaker
+  decisions, pool model scheduling, stream skip-vs-forward verdicts, and
+  the engine batcher's **batch-causality** spans (each dispatch span
+  records the rids of every request that shared it; each request span
+  records its batch peers, queue position, and pad fraction — so "my
+  request was slow" resolves to "it waited behind another tenant's burst
+  in bucket (600, 800) at occupancy 3/8").
+* :class:`Tracer` — the live sink.  Spans ride the existing telemetry
+  JSONL schema (``kind: "span"`` records, schema v1) with ADDITIVE
+  fields (``trace``/``sid``/``psid``/``member``/``attrs``) that old
+  readers ignore, written to ``spans_<member>.jsonl`` under the
+  telemetry dir — one file per fabric member, merged by trace id in
+  ``scripts/trace_query.py``.  Counters (``trace/spans_emitted`` /
+  ``trace/spans_dropped`` / ``trace/tail_kept``) mirror into whatever
+  telemetry sink is active, so Prometheus grows ``mxr_trace_*`` families
+  for free.
+* **Tail sampling** — every span is buffered per live trace; when the
+  trace's ROOT span ends, the full tree is kept only when the request
+  was slow (root duration at or above the windowed-p99 of roots seen in
+  the trailing window), errored, or was hedged/retried/shed.  Kept trees
+  land in a budget-bounded ring dumped to ``trace_tail_<member>.jsonl``
+  (atomic tmp+rename, the flight-recorder contract) so the forensics for
+  the requests that matter survive even when the spans stream didn't.
+* :class:`NullTracer` — the disabled default, the
+  ``NULL_CAPTURE.record_batch`` contract enforced the same hard way:
+  every recording method RAISES, so tests can pin that a tracing-off
+  engine adds zero work on the hot path (one ``tracer.enabled``
+  attribute check per batch, nothing else).
+
+Stdlib only — no jax import; safe in frontends, routers, and the
+loader's producer threads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import re
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.telemetry.sink import Hist, SCHEMA_VERSION
+
+# the one propagation header, hop to hop: "trace[-span[-flags]]"
+# (32 hex chars - 16 hex chars - 2 hex chars; flags 01 = sampled)
+TRACE_HEADER = "X-Mxr-Trace"
+
+# per-member file names under the telemetry dir (the query tool globs
+# both; members sharing a dir never collide — one file per member name)
+SPANS_PREFIX = "spans_"
+TAIL_PREFIX = "trace_tail_"
+
+# env opt-in: subprocess members (tests/fabric_worker.py, smoke scripts)
+# enable tracing without new CLI plumbing
+ENV_TRACE_DIR = "MXR_TRACE_DIR"
+ENV_TRACE_MEMBER = "MXR_TRACE_MEMBER"
+ENV_TRACE_SAMPLE = "MXR_TRACE_SAMPLE"
+
+# budget bounds: spans per live trace (a runaway loop must not hold one
+# trace's list forever) and concurrently-live traces (roots that never
+# finalize — crashed hops — are evicted oldest-first, unkept)
+MAX_SPANS_PER_TRACE = 64
+MAX_LIVE_TRACES = 1024
+
+_ID_RE = re.compile(r"^[0-9a-f]{8,32}$")
+_SID_RE = re.compile(r"^[0-9a-f]{1,16}$")
+
+
+def _trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def _span_id() -> str:
+    return os.urandom(8).hex()
+
+
+class TraceContext:
+    """One hop's view of a trace: which trace, which parent span, and
+    whether spans should be recorded at all.  ``span_id`` is the span
+    any child recorded under this context hangs from — ``None`` marks a
+    context with no parent yet (freshly minted, or a bare client-sent
+    trace id), whose first span is the trace's ROOT."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: Optional[str] = None,
+                 sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    @classmethod
+    def mint(cls, sampled: bool = True) -> "TraceContext":
+        return cls(_trace_id(), None, sampled)
+
+    @classmethod
+    def parse(cls, value) -> Optional["TraceContext"]:
+        """Accept ``trace``, ``trace-span``, or ``trace-span-flags``
+        (the header grammar); None on anything malformed — a frontend
+        mints fresh rather than serving a garbage id downstream."""
+        if not isinstance(value, str):
+            return None
+        parts = value.strip().lower().split("-")
+        if not parts or not _ID_RE.match(parts[0]):
+            return None
+        span = None
+        sampled = True
+        if len(parts) >= 2:
+            if not _SID_RE.match(parts[1]):
+                return None
+            # all-zero span id = "no parent" (the client-mint idiom)
+            span = None if set(parts[1]) == {"0"} else parts[1]
+        if len(parts) >= 3:
+            sampled = parts[2] != "00"
+        if len(parts) > 3:
+            return None
+        return cls(parts[0], span, sampled)
+
+    def to_header(self) -> str:
+        return (f"{self.trace_id}-{self.span_id or '0' * 16}-"
+                f"{'01' if self.sampled else '00'}")
+
+    def child(self) -> "TraceContext":
+        """A downstream context parented on a fresh span id."""
+        return TraceContext(self.trace_id, _span_id(), self.sampled)
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id!r}, {self.span_id!r}, "
+                f"sampled={self.sampled})")
+
+
+class _NullTraceSpan:
+    """The no-op span: hops call ``.set()`` and read ``.ctx``
+    unconditionally, so the unsampled path needs an inert twin."""
+
+    __slots__ = ()
+    ctx = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        pass
+
+
+NULL_SPAN = _NullTraceSpan()
+
+
+class TraceSpan:
+    """Context manager timing one hop.  ``.ctx`` is the context to hand
+    downstream (same trace, this span as parent); ``.set(**attrs)``
+    attaches hop decisions (picked member, hedged, skipped, status...)
+    to the record."""
+
+    __slots__ = ("_tracer", "_pctx", "name", "attrs", "ctx", "_t0", "_w0")
+
+    def __init__(self, tracer: "Tracer", pctx: TraceContext, name: str,
+                 attrs: dict):
+        self._tracer = tracer
+        self._pctx = pctx
+        self.name = name
+        self.attrs = dict(attrs)
+        self.ctx = TraceContext(pctx.trace_id, _span_id(), True)
+        self._t0 = self._w0 = None
+
+    def __enter__(self):
+        self._w0 = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def set(self, **attrs):
+        self.attrs.update(attrs)
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - (self._t0 or time.perf_counter())
+        if exc_type is not None:
+            self.attrs.setdefault("error",
+                                  f"{exc_type.__name__}: {exc}"[:200])
+        self._tracer.record(self._pctx, self.name, dur, ts=self._w0,
+                            attrs=self.attrs, sid=self.ctx.span_id)
+        return False
+
+
+class NullTracer:
+    """Tracing disabled: one ``enabled`` attribute check on hot paths,
+    and — the :data:`~mx_rcnn_tpu.flywheel.capture.NULL_CAPTURE`
+    contract enforced the same hard way — recording methods RAISE, so a
+    round-trip with tracing off proves the hot path never reached the
+    sink."""
+
+    enabled = False
+    member = "0"
+    rank = 0
+    counters: dict = {}
+
+    def mint(self, sampled: bool = True):
+        raise RuntimeError("tracing is disabled; hot paths must not mint")
+
+    def span(self, ctx, name, **attrs):
+        raise RuntimeError("tracing is disabled; hot paths must not record")
+
+    def record(self, ctx, name, dur_s, ts=None, attrs=None, sid=None):
+        raise RuntimeError("tracing is disabled; hot paths must not record")
+
+    def dump_tail(self):
+        return None
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+NULL_TRACER = NullTracer()
+
+
+class Tracer:
+    """The live tracing sink for one process (one fabric member).
+
+    Spans stream to ``spans_<member>.jsonl`` under ``out_dir`` in the
+    telemetry JSONL schema (``kind: "span"`` + additive trace fields);
+    full trees of slow/errored/hedged/retried/shed requests are kept in
+    a bounded ring and dumped to ``trace_tail_<member>.jsonl``."""
+
+    enabled = True
+
+    def __init__(self, out_dir: str, member: str = "0", rank: int = 0,
+                 sample: float = 1.0, tail_budget: int = 256,
+                 tail_window_s: float = 60.0, tail_quantile: float = 0.99):
+        self.out_dir = out_dir
+        self.member = re.sub(r"[^A-Za-z0-9._-]", "_", str(member)) or "0"
+        self.rank = int(rank)
+        self.sample = min(max(float(sample), 0.0), 1.0)
+        self.tail_quantile = float(tail_quantile)
+        self.tail_window_s = float(tail_window_s)
+        self._rng = random.Random(os.urandom(8))
+        self._lock = threading.Lock()
+        self._file = None
+        self._live: "dict[str, list]" = {}   # trace_id -> [span rec]
+        self._tail: deque = deque(maxlen=max(int(tail_budget), 1))
+        self._root_hist = Hist()  # root durations → windowed-p99 gate
+        self.counters = {"spans_emitted": 0, "spans_dropped": 0,
+                         "tail_kept": 0}
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            self.spans_path = os.path.join(
+                out_dir, f"{SPANS_PREFIX}{self.member}.jsonl")
+            self._file = open(self.spans_path, "w")
+
+    # -- recording -------------------------------------------------------
+
+    def mint(self, sampled: Optional[bool] = None) -> TraceContext:
+        """A fresh root context, honoring the configured sample rate."""
+        if sampled is None:
+            sampled = self.sample >= 1.0 or self._rng.random() < self.sample
+        return TraceContext.mint(sampled=bool(sampled))
+
+    def span(self, ctx: Optional[TraceContext], name: str, **attrs):
+        """Timed-block form.  ``ctx`` may be None or unsampled — the
+        caller gets the inert :data:`NULL_SPAN` and pays nothing."""
+        if ctx is None or not ctx.sampled:
+            return NULL_SPAN
+        return TraceSpan(self, ctx, name, attrs)
+
+    def record(self, ctx: Optional[TraceContext], name: str,
+               dur_s: float, ts: Optional[float] = None,
+               attrs: Optional[dict] = None,
+               sid: Optional[str] = None) -> Optional[str]:
+        """Already-measured form (the engine batcher's: durations are
+        computed after the batch resolves).  Returns the span id (the
+        parent for sub-spans) or None when nothing was recorded."""
+        if ctx is None or not ctx.sampled:
+            return None
+        sid = sid or _span_id()
+        rec = {"v": SCHEMA_VERSION, "t": time.time(), "rank": self.rank,
+               "kind": "span", "name": name, "dur_s": float(dur_s),
+               "trace": ctx.trace_id, "sid": sid, "member": self.member}
+        if ts is not None:
+            rec["ts"] = ts
+        if ctx.span_id is not None:
+            rec["psid"] = ctx.span_id
+        if attrs:
+            rec["attrs"] = {k: v for k, v in attrs.items()
+                            if v is not None}
+        root = ctx.span_id is None
+        with self._lock:
+            spans = self._live.get(ctx.trace_id)
+            if spans is None:
+                if len(self._live) >= MAX_LIVE_TRACES:
+                    # a trace whose root never finalized (crashed hop)
+                    evicted, dead = self._live.popitem()
+                    self.counters["spans_dropped"] += len(dead)
+                spans = self._live[ctx.trace_id] = []
+            if len(spans) >= MAX_SPANS_PER_TRACE:
+                self.counters["spans_dropped"] += 1
+                telemetry.get().counter("trace/spans_dropped")
+                return None
+            spans.append(rec)
+            self.counters["spans_emitted"] += 1
+            if self._file is not None:
+                self._file.write(json.dumps(rec) + "\n")
+                self._file.flush()
+        telemetry.get().counter("trace/spans_emitted")
+        if root:
+            self._finalize(ctx.trace_id, float(dur_s), attrs or {})
+        return sid
+
+    # -- tail sampling ---------------------------------------------------
+
+    def _keep(self, dur_s: float, attrs: dict) -> bool:
+        """The tail verdict: errors and hedged/retried/shed requests are
+        always forensic material; otherwise keep only roots at or above
+        the windowed-p99 of recent root durations (with few samples the
+        estimate degrades toward the max — the slowest request of a
+        young run is still kept, which is the right cold-start bias)."""
+        if attrs.get("error"):
+            return True
+        status = attrs.get("status")
+        if isinstance(status, int) and status != 200:
+            return True
+        if any(attrs.get(k) for k in ("hedged", "retried", "shed")):
+            return True
+        thresh = self._root_hist.window_quantile(self.tail_quantile,
+                                                 self.tail_window_s)
+        return thresh is not None and dur_s >= thresh
+    # NOTE: observe AFTER the verdict — a lone first request must not
+    # compare against itself and auto-keep every cold-start trace... it
+    # actually SHOULD be kept (it is the current p99), which observing
+    # after preserves only from the second request on; the first trace
+    # has no window yet and is dropped, bounding cold-start noise.
+
+    def _finalize(self, trace_id: str, dur_s: float, attrs: dict):
+        keep = self._keep(dur_s, attrs)
+        self._root_hist.observe(dur_s)
+        with self._lock:
+            spans = self._live.pop(trace_id, None)
+        if not keep or not spans:
+            return
+        with self._lock:
+            self._tail.append(spans)
+            self.counters["tail_kept"] += 1
+        telemetry.get().counter("trace/tail_kept")
+        self.dump_tail()
+
+    def dump_tail(self) -> Optional[str]:
+        """Atomically write the kept-trees ring to
+        ``trace_tail_<member>.jsonl`` (tmp + rename — the flight
+        recorder's torn-dump-proof contract)."""
+        if not self.out_dir:
+            return None
+        with self._lock:
+            trees = [list(t) for t in self._tail]
+        path = os.path.join(self.out_dir,
+                            f"{TAIL_PREFIX}{self.member}.jsonl")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            for tree in trees:
+                for rec in tree:
+                    f.write(json.dumps(rec) + "\n")
+        os.replace(tmp, path)
+        return path
+
+    # -- introspection / lifecycle ---------------------------------------
+
+    def metrics(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            out["live_traces"] = len(self._live)
+            out["tail_trees"] = len(self._tail)
+        out["sample"] = self.sample
+        return out
+
+    def flush(self):
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+
+    def close(self):
+        try:
+            self.dump_tail()
+        except OSError:
+            pass
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+# -- module-global lifecycle (the telemetry.configure/get twin) ----------
+
+_active: "NullTracer | Tracer" = NULL_TRACER
+
+
+def configure(out_dir: str, member: str = "0", rank: int = 0,
+              sample: float = 1.0, tail_budget: int = 256,
+              tail_window_s: float = 60.0,
+              tail_quantile: float = 0.99) -> Tracer:
+    """Open a tracer and make it the active one (one per process — the
+    ``spans_<member>.jsonl`` layout's writer contract)."""
+    global _active
+    if _active.enabled:
+        _active.close()
+    _active = Tracer(out_dir, member=member, rank=rank, sample=sample,
+                     tail_budget=tail_budget, tail_window_s=tail_window_s,
+                     tail_quantile=tail_quantile)
+    return _active
+
+
+def configure_from_env(member: Optional[str] = None,
+                       rank: int = 0) -> Optional[Tracer]:
+    """Enable tracing when ``MXR_TRACE_DIR`` is set — how subprocess
+    fabric members (tests, smoke scripts) opt in without CLI plumbing.
+    No-op (returns None) when the env var is absent or a tracer is
+    already active."""
+    out_dir = os.environ.get(ENV_TRACE_DIR, "").strip()
+    if not out_dir or _active.enabled:
+        return None
+    member = os.environ.get(ENV_TRACE_MEMBER, "").strip() or member
+    sample = float(os.environ.get(ENV_TRACE_SAMPLE, "") or 1.0)
+    return configure(out_dir, member=member if member is not None else "0",
+                     rank=rank, sample=sample)
+
+
+def get() -> "NullTracer | Tracer":
+    """The active tracer (:data:`NULL_TRACER` when tracing is off)."""
+    return _active
+
+
+def reset_null():
+    """Drop the active tracer WITHOUT closing it (forked children that
+    inherit the parent's open spans stream — the telemetry twin)."""
+    global _active
+    _active = NULL_TRACER
+
+
+def shutdown():
+    """Close the active tracer and restore the no-op default."""
+    global _active
+    _active.close()
+    _active = NULL_TRACER

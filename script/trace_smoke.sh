@@ -1,0 +1,171 @@
+#!/usr/bin/env bash
+# Distributed-tracing smoke (CPU-friendly): the ISSUE-16 pipeline over a
+# real fabric — one router plus TWO standalone TCP members (real model,
+# synthetic weights) with tracing ON, all span streams sharing one
+# telemetry dir.
+#
+#   1. Traffic — scripts/loadgen.py fires a traced burst
+#      (--trace-sample 1.0: every request carries a client-minted trace
+#      id).  loadgen itself asserts the echo contract (every 2xx
+#      response returns the id that was sent) and its --report rows gain
+#      the traced / tail_kept counts.
+#   2. Metrics — the router's Prometheus exposition must carry the
+#      mxr_trace_* families, and its /metrics JSON the trace section.
+#   3. Forensics — scripts/trace_query.py --slowest 3 must render
+#      multi-hop trees: the router's fabric/route span over the member's
+#      frontend/predict and engine/request batch-causality spans, i.e.
+#      ONE trace id across ≥3 hop types and ≥2 members.
+#   4. Reports — scripts/telemetry_report.py renders the "tracing"
+#      counter section and folds the spans into Chrome/Perfetto JSON
+#      with cross-hop flow arrows; scripts/perf_gate.py --check-format
+#      validates the SLO report with the new trace fields.
+#
+#   bash script/trace_smoke.sh
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${TRACE_SMOKE_DIR:-/tmp/mxr_trace_smoke}
+rm -rf "$dir"
+mkdir -p "$dir"
+tel="$dir/tel"               # events + spans_* + trace_tail_* together
+cache="$dir/program_cache"   # shared AOT warm-start: 3 boots, 1 compile
+
+common=(--network resnet50 --synthetic --serve-batch 2 --max-delay-ms 20
+        --max-queue 32 --deadline-ms 120000 --program-cache "$cache"
+        --cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)"
+        --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32)
+
+# three free localhost ports: router, member 0, member 1
+read -r RP M0 M1 <<<"$(python - <<'EOF'
+import socket
+socks = [socket.socket() for _ in range(3)]
+for s in socks:
+    s.bind(("127.0.0.1", 0))
+print(" ".join(str(s.getsockname()[1]) for s in socks))
+for s in socks:
+    s.close()
+EOF
+)"
+
+wait_ready() {
+python - "$1" "$2" "$3" <<'EOF'
+import os, sys, time
+from mx_rcnn_tpu.serve import tcp_http_request
+port, pid, want = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("server exited before becoming ready")
+    try:
+        status, doc = tcp_http_request("127.0.0.1", port, "GET", "/readyz",
+                                       timeout=5)
+        if want <= 1 and status == 200:
+            sys.exit(0)
+        if want > 1 and doc.get("ready_members", 0) >= want:
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1)
+sys.exit("server never became ready")
+EOF
+}
+
+# ---- fabric up: router + 2 members, tracing on everywhere ---------------
+echo "trace_smoke: [1/4] traced fabric boot + loadgen echo assertion"
+python serve.py --network resnet50 --fabric --port "$RP" \
+  --probe-interval-s 1 --telemetry-dir "$tel" \
+  --trace --trace-dir "$tel" &
+rpid=$!
+mports=("$M0" "$M1")
+mpids=()
+for i in 0 1; do
+  MXR_REPLICA_INDEX=$i python serve.py "${common[@]}" \
+    --port "${mports[i]}" --join "127.0.0.1:$RP" \
+    --trace --trace-dir "$tel" &
+  mpids[i]=$!
+done
+trap 'kill "$rpid" "${mpids[@]}" 2>/dev/null || true' EXIT
+wait_ready "$RP" "$rpid" 2
+
+# every request client-minted + echo-asserted; the report rows carry
+# traced / tail_kept (additive mxr_slo_report fields)
+python scripts/loadgen.py --port "$RP" --n 24 --rate 10 \
+  --short 80 --long 110 --scenario steady --trace-sample 1.0 \
+  --assert-2xx --report "$dir/SLO_r01.json" | tee "$dir/loadgen.json"
+
+python - "$dir/SLO_r01.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["schema"] == "mxr_slo_report", doc
+sc = doc["scenarios"][0]
+assert sc["traced"] == 24, f"expected every request traced: {sc}"
+assert sc.get("tail_kept") is None or sc["tail_kept"] >= 0, sc
+print(f"trace_smoke: loadgen OK (traced={sc['traced']}, "
+      f"tail_kept={sc.get('tail_kept')})")
+EOF
+
+# ---- act 2: mxr_trace_* on the router's metrics surfaces ----------------
+echo "trace_smoke: [2/4] mxr_trace_* families on /metrics"
+python - "$RP" <<'EOF'
+import http.client, sys
+from mx_rcnn_tpu.serve import tcp_http_request
+port = int(sys.argv[1])
+status, m = tcp_http_request("127.0.0.1", port, "GET", "/metrics",
+                             timeout=10)
+assert status == 200 and m["trace"]["spans_emitted"] > 0, m.get("trace")
+conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+conn.request("GET", "/metrics?format=prom")
+resp = conn.getresponse()
+text = resp.read().decode()
+conn.close()
+assert resp.status == 200, text[:200]
+for fam in ("mxr_trace_spans_emitted_total", "mxr_trace_tail_kept_total"):
+    assert fam in text, f"{fam} missing from the Prometheus exposition"
+print(f"trace_smoke: metrics OK (router spans_emitted="
+      f"{m['trace']['spans_emitted']}, tail_kept={m['trace']['tail_kept']})")
+EOF
+
+kill -TERM "${mpids[@]}" "$rpid"
+wait "$rpid" || true
+wait "${mpids[@]}" || true
+trap - EXIT
+
+# ---- act 3: per-trace forensics across the span files -------------------
+echo "trace_smoke: [3/4] trace_query --slowest renders multi-hop trees"
+python scripts/trace_query.py --telemetry-dir "$tel" --slowest 3 \
+  | tee "$dir/trees.txt"
+python - "$dir/trees.txt" <<'EOF'
+import sys
+blob = open(sys.argv[1]).read()
+for hop in ("fabric/route", "frontend/predict", "engine/request",
+            "engine/dispatch"):
+    assert hop in blob, f"hop {hop} missing from the slowest trees"
+assert "[router]" in blob, "router hop missing"
+assert "[member0]" in blob or "[member1]" in blob, "member hop missing"
+assert "batch_rids=" in blob, "batch-causality attrs missing"
+print("trace_smoke: forensics OK (cross-hop trees render)")
+EOF
+
+# ---- act 4: report + Perfetto + gate format -----------------------------
+echo "trace_smoke: [4/4] telemetry report, Perfetto fold, gate format"
+python scripts/telemetry_report.py "$tel" --trace "$dir/perfetto.json" \
+  | tee "$dir/report.txt"
+python - "$dir/report.txt" "$dir/perfetto.json" <<'EOF'
+import json, sys
+blob = open(sys.argv[1]).read()
+assert "tracing" in blob, "no tracing section in the report"
+assert "trace/spans_emitted" in blob, "trace counters missing"
+doc = json.load(open(sys.argv[2]))
+events = doc["traceEvents"]
+spans = [e for e in events if e.get("ph") == "X"
+         and e.get("args", {}).get("trace")]
+assert spans, "no span slices in the Perfetto fold"
+assert len({e["pid"] for e in spans}) >= 2, \
+    "spans did not fold into per-member process groups"
+flows = {e["ph"] for e in events if e.get("ph") in ("s", "t")}
+assert flows == {"s", "t"}, f"cross-hop flow arrows missing: {flows}"
+print(f"trace_smoke: perfetto OK ({len(spans)} span slices, "
+      f"{len({e['pid'] for e in spans})} process groups)")
+EOF
+python scripts/perf_gate.py --check-format "$dir"/SLO_r*.json
+echo "trace_smoke: OK"

@@ -237,6 +237,13 @@ def parse_args(argv=None):
                     help="multi-model mode: attach this aggregate "
                          "imgs_per_sec floor to the mixed report row "
                          "(what perf_gate.py enforces)")
+    ap.add_argument("--trace-sample", type=float, default=0.0,
+                    dest="trace_sample",
+                    help="fraction of requests that carry a client-minted"
+                         " distributed-trace id in the 'trace' doc field "
+                         "(seeded); the server must echo it back — a "
+                         "mismatch fails the run.  Output lines and "
+                         "--report rows gain traced / tail_kept counts")
     return ap.parse_args(argv)
 
 
@@ -285,6 +292,11 @@ def make_payloads(args, seed=None, size_mix=False):
         doc = encode_image_payload(img)
         if args.deadline_ms > 0:
             doc["deadline_ms"] = args.deadline_ms
+        if (getattr(args, "trace_sample", 0.0) > 0
+                and rng.random_sample() < args.trace_sample):
+            # client-minted trace id (bare 32-hex = root context); the
+            # server echoes it under "trace" in the response
+            doc["trace"] = rng.bytes(16).hex()
         docs.append(doc)
     return docs
 
@@ -358,6 +370,46 @@ def flywheel_capture_stats(args, timeout=10.0):
             "sample_every": max(int(fw.get("sample_every", 1)), 1)}
 
 
+def trace_stats(args, timeout=10.0):
+    """``{"spans_emitted": n, "tail_kept": k}`` from the target's
+    ``/metrics`` trace section (engine server or fabric router); ``{}``
+    when the endpoint is unreachable or tracing is off there."""
+    try:
+        if args.unix_socket:
+            status, doc = unix_http_request(args.unix_socket, "GET",
+                                            "/metrics", timeout=timeout)
+        else:
+            conn = http.client.HTTPConnection(args.host, args.port,
+                                              timeout=timeout)
+            try:
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                status, doc = resp.status, json.loads(resp.read())
+            finally:
+                conn.close()
+    except (OSError, ValueError):
+        return {}
+    if status != 200 or not isinstance(doc, dict):
+        return {}
+    tr = doc.get("trace")
+    if not isinstance(tr, dict):
+        return {}
+    return {k: int(tr.get(k, 0))
+            for k in ("spans_emitted", "tail_kept")}
+
+
+def trace_echo_failure(results):
+    """None when every echoed trace id matched what was sent, else the
+    stderr failure line (run_requests records mismatches as errors on
+    otherwise-2xx results)."""
+    mism = sorted({r[3] for r in results
+                   if r[3] and r[3].startswith("trace echo mismatch")})
+    if not mism:
+        return None
+    return (f"loadgen: trace echo assertion failed "
+            f"({len(mism)} distinct): {'; '.join(mism[:3])}")
+
+
 def capture_check_failure(before, after, ok_submits, tolerance):
     """None when the server's captured-count delta matches
     ``ok_submits / sample_every`` within ``tolerance`` (relative, with
@@ -411,8 +463,13 @@ def run_requests(args, docs, offsets):
                           f"{type(e).__name__}: {e}",
                           time.perf_counter() - t_start)
             return
+        err = None
+        sent = docs[i].get("trace")
+        if sent and 200 <= status < 300 and resp.get("trace") != sent:
+            err = (f"trace echo mismatch: sent {sent}, got "
+                   f"{resp.get('trace')!r}")
         results[i] = (status, time.perf_counter() - t0,
-                      resp.get("queue_wait_ms"), None,
+                      resp.get("queue_wait_ms"), err,
                       time.perf_counter() - t_start)
 
     t_start = time.perf_counter()
@@ -865,6 +922,10 @@ def main(argv=None):
                                            timeout=args.timeout)
             out["member_share"] = member_share(before, after)
             out["fabric_members"] = len(after)
+        if args.trace_sample > 0:
+            out["traced"] = sum(1 for d in docs if "trace" in d)
+            out["tail_kept"] = trace_stats(
+                args, timeout=args.timeout).get("tail_kept")
         if scenario is not None:
             out = {"scenario": scenario, **out}
         if scenario is not None or args.report:
@@ -873,7 +934,7 @@ def main(argv=None):
                 if k in ("requests", "status", "p50_ms", "p99_ms",
                          "error_rate", "availability", "time_to_recover_s",
                          "imgs_per_sec", "wall_s", "member_share",
-                         "fabric_members")}})
+                         "fabric_members", "traced", "tail_kept")}})
         print(json.dumps(out))
 
     if args.report:
@@ -887,6 +948,12 @@ def main(argv=None):
         ok = sum(1 for r in all_results if 200 <= r[0] < 300)
         msg = capture_check_failure(capture_before, after, ok,
                                     args.capture_tolerance)
+        if msg is not None:
+            print(msg, file=sys.stderr)
+            sys.exit(1)
+
+    if args.trace_sample > 0:
+        msg = trace_echo_failure(all_results)
         if msg is not None:
             print(msg, file=sys.stderr)
             sys.exit(1)

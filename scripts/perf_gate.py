@@ -119,6 +119,22 @@ def slo_report_rows(doc: dict) -> list:
             if slack:
                 row["abs_slack"] = slack
             rows.append(row)
+        # distributed-tracing ride-alongs (loadgen --trace-sample):
+        # counts scale with --n so they are validated (--check-format),
+        # not trend-gated; a run may pin "traced_floor" to make "the
+        # client minted ids but none were echoed/counted" a hard failure
+        traced = sc.get("traced")
+        if isinstance(traced, (int, float)):
+            row = {"metric": f"slo_{name}_traced", "value": traced,
+                   "unit": "requests"}
+            floor = sc.get("traced_floor")
+            if isinstance(floor, (int, float)):
+                row["floor"] = floor
+            rows.append(row)
+        tail = sc.get("tail_kept")
+        if isinstance(tail, (int, float)):
+            rows.append({"metric": f"slo_{name}_tail_kept",
+                         "value": tail, "unit": "traces"})
     return rows
 
 

@@ -44,6 +44,12 @@ loader / readback / host-post-process wait split and the overlap
 fraction (how much host post-process hid under the device forward), so
 serial-vs-pipelined-vs-device-postprocess comparisons read off one
 table.
+
+Run dirs also expand distributed-trace span streams
+(``spans_<member>.jsonl``, a serve.py --trace run): a "tracing" counter
+section appears, and ``--trace out.json`` folds the cross-hop spans
+into per-member Perfetto process groups with flow arrows linking each
+trace id across hops (per-trace forensics: scripts/trace_query.py).
 """
 
 import argparse

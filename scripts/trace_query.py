@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Query distributed request traces: trace id → its cross-hop span tree.
+
+  python scripts/trace_query.py --telemetry-dir /tmp/t 0123abcd...
+  python scripts/trace_query.py --telemetry-dir /tmp/t --slowest 3
+  python scripts/trace_query.py --telemetry-dir /tmp/t --list
+
+Merges every member's span stream under ``--telemetry-dir`` — the live
+``spans_<member>.jsonl`` files plus the tail-sampled
+``trace_tail_<member>.jsonl`` forensics dumps (deduped by (trace, span)
+— a span can appear in both) — groups by trace id, and prints each
+requested trace as an indented hop tree with per-hop durations:
+
+  trace 9f2c...e1 — root fabric/route 18.42ms, 6 spans, 3 members
+    fabric/route 18.42ms [router] member=m0 status=200
+      frontend/predict 17.90ms [member0] status=200
+        engine/request 16.77ms [member0] rid=12 peers=[13,14] ...
+          engine/dispatch 9.31ms [member0] batch_rids=[12,13,14] ...
+            engine/forward 7.02ms [member0]
+
+The tree hangs children from parent span ids (``psid`` → ``sid``);
+spans whose parent never landed (a crashed hop, a member whose file was
+lost) print as extra roots rather than vanishing.  ``--slowest N``
+ranks traces by their ROOT span duration — the client-observed hop —
+and prints the N worst, which is the "why was my p99 bad" entry point;
+``--list`` prints one summary line per trace.  Trace ids may be
+abbreviated to any unambiguous prefix.  Pure stdlib — no jax, no numpy;
+safe anywhere the telemetry dir is mounted.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mx_rcnn_tpu.telemetry.tracectx import (SPANS_PREFIX,  # noqa: E402
+                                            TAIL_PREFIX)
+
+# attrs printed inline after the hop name, in this order when present;
+# anything else prints afterward alphabetically
+ATTR_ORDER = ("status", "member", "rid", "peers", "batch_rids",
+              "queue_pos", "queue_wait_ms", "pad_frac", "bucket",
+              "occupancy", "skipped", "model", "hedged", "retried",
+              "shed", "error")
+
+
+def load_spans(telemetry_dir):
+    """Every trace span under the dir, live + tail streams merged and
+    deduped by (trace, sid).  Torn lines are skipped, not fatal — a
+    query against a live run must not die on a mid-write record."""
+    by_key = {}
+    for prefix in (SPANS_PREFIX, TAIL_PREFIX):
+        pattern = os.path.join(telemetry_dir, f"{prefix}*.jsonl")
+        for path in sorted(glob.glob(pattern)):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (not isinstance(rec, dict)
+                            or rec.get("kind") != "span"
+                            or not rec.get("trace")):
+                        continue
+                    by_key[(rec["trace"], rec.get("sid"))] = rec
+    return list(by_key.values())
+
+
+def span_start(rec):
+    ts = rec.get("ts")
+    if ts is not None:
+        return float(ts)
+    return float(rec.get("t", 0.0)) - float(rec.get("dur_s", 0.0))
+
+
+def group_traces(spans):
+    traces = {}
+    for rec in spans:
+        traces.setdefault(rec["trace"], []).append(rec)
+    for recs in traces.values():
+        recs.sort(key=span_start)
+    return traces
+
+
+def roots_of(recs):
+    """Tree roots: spans with no parent, plus orphans whose parent span
+    never landed (lost member file / crashed hop)."""
+    sids = {r.get("sid") for r in recs}
+    return [r for r in recs
+            if r.get("psid") is None or r["psid"] not in sids]
+
+
+def root_duration(recs):
+    """The trace's client-observed duration: its true root span when
+    one landed, else the longest span (best effort on partial trees)."""
+    true = [r for r in recs if r.get("psid") is None]
+    pool = true or recs
+    return max(float(r.get("dur_s", 0.0)) for r in pool)
+
+
+def format_attrs(rec):
+    attrs = dict(rec.get("attrs") or {})
+    parts = []
+    for key in ATTR_ORDER:
+        if key in attrs:
+            parts.append(f"{key}={json.dumps(attrs.pop(key))}")
+    for key in sorted(attrs):
+        parts.append(f"{key}={json.dumps(attrs[key])}")
+    return " ".join(parts)
+
+
+def render_tree(recs, out):
+    children = {}
+    for r in recs:
+        if r.get("psid") is not None:
+            children.setdefault(r["psid"], []).append(r)
+
+    def emit(rec, depth):
+        dur_ms = float(rec.get("dur_s", 0.0)) * 1e3
+        line = (f"{'  ' * depth}{rec.get('name', '?')} {dur_ms:.2f}ms "
+                f"[{rec.get('member', '?')}]")
+        extra = format_attrs(rec)
+        out.append(line + (f" {extra}" if extra else ""))
+        for child in sorted(children.get(rec.get("sid"), []),
+                            key=span_start):
+            emit(child, depth + 1)
+
+    for root in sorted(roots_of(recs), key=span_start):
+        emit(root, 1)
+
+
+def summary_line(trace_id, recs):
+    members = sorted({str(r.get("member", "?")) for r in recs})
+    true = [r for r in recs if r.get("psid") is None]
+    root_name = true[0].get("name", "?") if true else "(no root)"
+    return (f"trace {trace_id} — root {root_name} "
+            f"{root_duration(recs) * 1e3:.2f}ms, {len(recs)} span(s), "
+            f"{len(members)} member(s): {','.join(members)}")
+
+
+def resolve_ids(traces, wanted):
+    """Abbreviated trace ids → full ids (unique prefix match)."""
+    out = []
+    for w in wanted:
+        w = w.strip().lower()
+        hits = [t for t in traces if t == w] or sorted(
+            t for t in traces if t.startswith(w))
+        if not hits:
+            raise SystemExit(f"trace_query: no trace matching {w!r} "
+                             f"({len(traces)} trace(s) on disk)")
+        if len(hits) > 1:
+            raise SystemExit(f"trace_query: ambiguous prefix {w!r} "
+                             f"matches {len(hits)} traces "
+                             f"({', '.join(hits[:4])}...)")
+        out.append(hits[0])
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_ids", nargs="*",
+                    help="trace id(s) to print (unambiguous prefixes ok)")
+    ap.add_argument("--telemetry-dir", required=True, dest="telemetry_dir",
+                    help="dir holding spans_<member>.jsonl / "
+                         "trace_tail_<member>.jsonl (serve.py --trace-dir)")
+    ap.add_argument("--slowest", type=int, default=0,
+                    help="print the N traces with the slowest root span")
+    ap.add_argument("--list", action="store_true", dest="list_all",
+                    help="one summary line per trace, slowest first")
+    args = ap.parse_args(argv)
+
+    spans = load_spans(args.telemetry_dir)
+    traces = group_traces(spans)
+    if not traces:
+        raise SystemExit(f"trace_query: no trace spans under "
+                         f"{args.telemetry_dir} (tracing off, or nothing "
+                         f"sampled yet?)")
+
+    by_slow = sorted(traces, key=lambda t: -root_duration(traces[t]))
+    if args.list_all:
+        for trace_id in by_slow:
+            print(summary_line(trace_id, traces[trace_id]))
+        return
+    chosen = resolve_ids(traces, args.trace_ids)
+    if args.slowest > 0:
+        chosen.extend(t for t in by_slow[:args.slowest]
+                      if t not in chosen)
+    if not chosen:
+        raise SystemExit("trace_query: pass trace id(s), --slowest N, "
+                         "or --list")
+    for trace_id in chosen:
+        recs = traces[trace_id]
+        lines = [summary_line(trace_id, recs)]
+        render_tree(recs, lines)
+        print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()

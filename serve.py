@@ -227,7 +227,53 @@ def parse_args():
                              "--models: param trees beyond it are paged "
                              "host<->device (LRU by last dispatch, "
                              "pinned models exempt).  0 = unbounded")
+    # -- distributed request tracing (ISSUE 16) — OFF by default: every
+    # hop keeps the NULL tracer (one attribute check, zero span work)
+    parser.add_argument("--trace", action="store_true",
+                        help="enable distributed request tracing: mint/"
+                             "accept X-Mxr-Trace contexts at the frontend,"
+                             " record per-hop spans (router pick/hedge/"
+                             "retry, pool sched, stream gate, engine "
+                             "batch-causality) to spans_<member>.jsonl "
+                             "under --trace-dir, tail-sample slow/errored "
+                             "trees to trace_tail_<member>.jsonl; query "
+                             "with scripts/trace_query.py")
+    parser.add_argument("--trace-dir", default="", dest="trace_dir",
+                        help="span-file directory (default: "
+                             "--telemetry-dir; one of the two is required "
+                             "with --trace)")
+    parser.add_argument("--trace-sample", type=float, default=1.0,
+                        dest="trace_sample",
+                        help="fraction of frontend-minted traces that are "
+                             "sampled (client-sent contexts keep their "
+                             "own sampled flag)")
+    parser.add_argument("--trace-tail-budget", type=int, default=256,
+                        dest="trace_tail_budget",
+                        help="kept slow/errored span trees in the tail "
+                             "ring (oldest evicted beyond this)")
     return parser.parse_args()
+
+
+def _configure_tracing(args, member: str, rank: int = 0) -> None:
+    """--trace → an active tracer for this process; without the flag,
+    honor the MXR_TRACE_DIR env opt-in (subprocess members inherit it),
+    else leave the NULL tracer in place.  Closed via atexit so the tail
+    ring and spans stream land on every normal exit path."""
+    from mx_rcnn_tpu.telemetry import tracectx
+
+    if getattr(args, "trace", False):
+        out_dir = args.trace_dir or args.telemetry_dir
+        if not out_dir:
+            raise SystemExit("--trace needs --trace-dir or "
+                             "--telemetry-dir")
+        tracectx.configure(out_dir, member=member, rank=rank,
+                           sample=args.trace_sample,
+                           tail_budget=args.trace_tail_budget)
+        atexit.register(tracectx.shutdown)
+        logger.info("tracing: spans_%s.jsonl under %s (sample=%.2f)",
+                    member, out_dir, args.trace_sample)
+    elif tracectx.configure_from_env(member=member, rank=rank) is not None:
+        atexit.register(tracectx.shutdown)
 
 
 def parse_model_specs(models: str, model_args) -> list:
@@ -414,6 +460,7 @@ def main_single(args):
                                         "serve_batch": args.serve_batch,
                                         "max_delay_ms": args.max_delay_ms},
                               configure_telemetry=True)
+    _configure_tracing(args, "server")
     predictor, engine = _build_engine(args, cfg)
     warmup(engine)
     stream = None
@@ -483,6 +530,7 @@ def main_multimodel(args):
                                         "serve_batch": args.serve_batch,
                                         "max_delay_ms": args.max_delay_ms},
                               configure_telemetry=True)
+    _configure_tracing(args, "server")
     pool, streams = _build_pool(args)
     default = pool.default_model
     server = make_server(pool.engine_for(default),
@@ -521,6 +569,8 @@ def main_replica(args):
                               run_meta={"network": args.network,
                                         "replica": args.replica_index},
                               configure_telemetry=True)
+    _configure_tracing(args, f"member{args.replica_index}",
+                       rank=args.replica_index + 1)
     predictor, engine = _build_engine(args, cfg)
     done = threading.Event()
     _install_signals(done)
@@ -549,6 +599,7 @@ def main_plane(args):
                               run_meta={"network": args.network,
                                         "replicas": args.replicas},
                               configure_telemetry=True)
+    _configure_tracing(args, "router")
     sock_dir = tempfile.mkdtemp(prefix="mxr_replicas_")
     specs = replica_specs(sys.argv, args.replicas, sock_dir,
                           devices=args.replica_devices)
@@ -611,6 +662,7 @@ def main_member(args):
                                         "join": args.join,
                                         "member_index": index},
                               configure_telemetry=True)
+    _configure_tracing(args, f"member{index}", rank=index)
     predictor, engine = _build_engine(args, cfg)
     done = threading.Event()
     _install_signals(done)
@@ -643,6 +695,7 @@ def main_fabric(args):
                                         "fabric": True,
                                         "replicas": args.replicas},
                               configure_telemetry=True)
+    _configure_tracing(args, "router")
     pool = ReplicaPool(FabricOptions(
         probe_interval_s=args.probe_interval_s,
         hedge_after_ms=args.hedge_after_ms,

@@ -174,6 +174,38 @@ def test_mine_ranks_topk_with_provenance_and_idempotent_digest(tmp_path):
     assert doc["total_scanned"] == 10 and len(doc["entries"]) == 5
 
 
+def test_trace_id_provenance_capture_to_manifest_round_trip(tmp_path):
+    """ISSUE-16 provenance: a trace id riding the capture entry (the
+    engine's 5-tuple with tracing on) lands in the shard row's meta and
+    survives mining into the manifest entry — so a mined hard example
+    points back at its originating request's span tree."""
+    d = str(tmp_path / "capture")
+    cap = RequestCapture(CaptureOptions(capture_dir=d, sample_every=1,
+                                        shard_records=2))
+    rng = np.random.RandomState(0)
+    px = rng.randint(0, 255, (64, 96, 3), dtype=np.uint8)
+    tid = "ab" * 16
+    cap.record_batch([(px, (60, 90), (120, 180), synth_dets(rng, 4), tid)],
+                     generation=3)
+    # untraced entries (the 4-tuple back-compat shape) stay untagged
+    cap.record_batch([(px, (60, 90), (120, 180), synth_dets(rng, 4))],
+                     generation=3)
+    cap.close()
+    rows = []
+    for sh in list_shards(d):
+        with open(sh["jsonl"]) as fh:
+            rows.extend(json.loads(line) for line in fh)
+    assert rows[0]["trace_id"] == tid
+    assert "trace_id" not in rows[1]
+    entries, scanned, _ = mine_shards(d, top_k=2, min_label_score=0.3)
+    assert scanned == 2
+    by_key = {e["key"]: e for e in entries}
+    assert by_key[rows[0]["key"]]["trace_id"] == tid
+    assert by_key[rows[1]["key"]]["trace_id"] is None
+    doc = load_manifest(write_manifest(d, entries, scanned, 2))
+    assert {e.get("trace_id") for e in doc["entries"]} == {tid, None}
+
+
 def test_mine_skips_unlabeled_and_torn_rows(tmp_path, monkeypatch):
     d, _ = fill_capture(tmp_path, n=4, shard_records=4)
     # append a torn row + an unlabeled (all-low-score) row to the shard
