@@ -9,8 +9,15 @@ Subcommands:
          after ``--``) gets ``--replay-manifest <path>`` appended each
          round.  Serving replicas pick up the resulting checkpoints via
          ``--watch-checkpoints`` on their own.
+  fleet  the distributed loop (ISSUE 17): merge per-member capture
+         manifests, fold per-member rankings into one global top-K,
+         train, then promote fleet-wide over ``--promote-to`` (the
+         fabric router's /admin/reload) gated on the held-out
+         eval-shard quality check; rounds repeat until a generation
+         promotes, then continue only on score-distribution drift.
 
 Each invocation prints one JSON line so smoke scripts can consume it.
+The single-host ``mine``/``loop`` path is untouched by fleet mode.
 """
 
 from __future__ import annotations
@@ -26,7 +33,7 @@ from mx_rcnn_tpu.flywheel import FlywheelLoop
 def parse_args(argv=None):
     parser = argparse.ArgumentParser(description="Data flywheel driver")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for name in ("mine", "loop"):
+    for name in ("mine", "loop", "fleet"):
         p = sub.add_parser(name)
         p.add_argument("--capture-dir", required=True,
                        help="dir the serve engine spills shards into")
@@ -38,8 +45,25 @@ def parse_args(argv=None):
         p.add_argument("--out-dir", default=None,
                        help="manifest output dir (default: capture dir)")
         p.add_argument("--telemetry-dir", default=None)
-        if name == "loop":
+        if name in ("loop", "fleet"):
             p.add_argument("--rounds", type=int, default=1)
+        if name == "fleet":
+            p.add_argument("--promote-to", default=None,
+                           help="fabric router address (host:port) the "
+                                "promotion POSTs /admin/reload to")
+            p.add_argument("--ckpt-prefix", default=None,
+                           help="checkpoint prefix the trainer saves "
+                                "under; newest committed save is the "
+                                "promotion candidate")
+            p.add_argument("--eval-every", type=int, default=4,
+                           help="every Nth mined record is held out for "
+                                "the promotion gate instead of trained on")
+            p.add_argument("--quality-slack", type=float, default=0.0,
+                           help="candidate may score this far below the "
+                                "incumbent and still promote")
+            p.add_argument("--drift-threshold", type=float, default=0.25)
+            p.add_argument("--drift-window", type=int, default=64)
+        if name in ("loop", "fleet"):
             p.add_argument("train_cmd", nargs=argparse.REMAINDER,
                            help="train command after --; gets "
                                 "--replay-manifest appended per round")
@@ -51,8 +75,35 @@ def main(argv=None):
     if args.telemetry_dir:
         telemetry.configure(args.telemetry_dir, rank=0, world=1)
     train_cmd = None
-    if args.cmd == "loop":
+    if args.cmd in ("loop", "fleet"):
         train_cmd = [t for t in args.train_cmd if t != "--"] or None
+    if args.cmd == "fleet":
+        from mx_rcnn_tpu.flywheel import FleetFlywheel
+        fleet = FleetFlywheel(
+            args.capture_dir, top_k=args.top_k,
+            min_label_score=args.min_label_score, out_dir=args.out_dir,
+            train_cmd=train_cmd, ckpt_prefix=args.ckpt_prefix,
+            promote_to=args.promote_to, eval_every=args.eval_every,
+            quality_slack=args.quality_slack,
+            drift_threshold=args.drift_threshold,
+            drift_window=args.drift_window)
+        results = fleet.run(args.rounds)
+        if args.telemetry_dir:
+            telemetry.shutdown()
+        last = results[-1]
+        print(json.dumps({"cmd": "fleet", "rounds": len(results),
+                          "mined": last["mined"],
+                          "scanned": last["scanned"],
+                          "eval": last.get("eval"),
+                          "members": last["members"],
+                          "mine_failed": last["mine_failed"],
+                          "duplicates_dropped":
+                              last.get("duplicates_dropped"),
+                          "manifest": last["manifest"],
+                          "train_rc": last["train_rc"],
+                          "promoted": fleet.promoted_rounds,
+                          "drift": last.get("drift")}))
+        return 0 if fleet.promoted_rounds else 1
     loop = FlywheelLoop(args.capture_dir, top_k=args.top_k,
                         min_label_score=args.min_label_score,
                         out_dir=args.out_dir, train_cmd=train_cmd)

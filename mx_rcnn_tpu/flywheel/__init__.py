@@ -8,13 +8,27 @@ Three stages close the serve -> train -> serve loop:
   disagreement, low max score) and writes a ``mined-<digest>.json`` manifest.
 - :mod:`loop` — orchestrates capture -> mine -> replay-train rounds; the
   replay side lives in :class:`mx_rcnn_tpu.data.replay.ReplayDataset`.
+- :mod:`fleet` — the fabric-scale loop (ISSUE 17): per-member capture
+  manifests merged fault-tolerantly, a distributed mine folded into one
+  global top-K, and promotion gated on a measured eval-shard quality delta
+  with drift detection triggering the next round.
 """
 
-from .capture import CaptureOptions, NullCapture, NULL_CAPTURE, RequestCapture
-from .miner import mine_shards, write_manifest, load_manifest
-from .loop import FlywheelLoop
+from .capture import (CaptureOptions, NullCapture, NULL_CAPTURE,
+                      RequestCapture, list_member_manifests, member_id,
+                      merge_manifests)
+from .miner import (fold_rankings, load_manifest, mine_member, mine_shards,
+                    write_manifest)
+from .loop import FlywheelLoop, run_train_cmd
+from .fleet import (DriftDetector, FleetFlywheel, build_eval_shard,
+                    detection_agreement, eval_shard_quality,
+                    load_eval_shard)
 
 __all__ = [
     "CaptureOptions", "NullCapture", "NULL_CAPTURE", "RequestCapture",
-    "mine_shards", "write_manifest", "load_manifest", "FlywheelLoop",
+    "list_member_manifests", "member_id", "merge_manifests",
+    "mine_shards", "mine_member", "fold_rankings", "write_manifest",
+    "load_manifest", "FlywheelLoop", "run_train_cmd",
+    "FleetFlywheel", "DriftDetector", "build_eval_shard",
+    "detection_agreement", "eval_shard_quality", "load_eval_shard",
 ]

@@ -14,20 +14,41 @@ no headers, no raw request bytes.
 Captured records accumulate in a bounded in-memory ring and spill to disk
 as shard pairs under the capture dir::
 
-    shard-000000.npz     # uint8 pixel arrays, one key per record
-    shard-000000.jsonl   # one JSON row per record: sidecars, stats, dets
+    shard-<member>-<pid>-000000.npz    # uint8 pixel arrays, one key each
+    shard-<member>-<pid>-000000.jsonl  # one JSON row per record
 
 Both files are written via tmp + ``os.replace`` and the npz lands first, so
 a visible ``.jsonl`` implies its pixels exist.  A byte budget rotates the
 oldest shard pairs out.
 
+Fleet capture (ISSUE 17): the shard name folds in a MEMBER id (``--capture-
+member`` or the sanitized hostname) ahead of the pid — two fleet members
+sharing one capture dir over a network filesystem can collide on pid alone
+(separate pid namespaces), never on member+pid.  Each writer additionally
+maintains an atomic per-member manifest::
+
+    manifest-<member>-<pid>.json   # schema mxr_capture_manifest
+
+listing every shard it has spilled plus its counters, so the distributed
+miner reads exactly what each member claims to have delivered instead of
+globbing a dir that other members are still mutating.
+:func:`merge_manifests` folds them into one fleet view, tolerating absent
+or late members (whoever has published is merged), torn manifest files
+(skipped), and duplicate deliveries (same member+pid twice — highest
+sequence wins, duplicates counted).
+
 Fault injection (chaos tests): the env vars below name a shard index whose
 spill is corrupted/truncated after the atomic rename, simulating torn disks
 so the replay loader's bad-record substitution path can be pinned.
+``MXR_FAULT_FLYWHEEL_DUP_MANIFEST`` names a member id (or ``*``) whose
+manifest is delivered TWICE under different names — the at-least-once
+delivery shape the merge step must dedup.
 """
 
 import json
 import os
+import re
+import socket
 import threading
 from dataclasses import dataclass
 from typing import Optional
@@ -41,6 +62,11 @@ from mx_rcnn_tpu import telemetry
 # the 0-based index of the shard to damage after it has been spilled.
 ENV_CORRUPT_SHARD = "MXR_FAULT_FLYWHEEL_CORRUPT_SHARD"
 ENV_TRUNCATE_SPILL = "MXR_FAULT_FLYWHEEL_TRUNCATE_SPILL"
+# value = member id (or "*" for any) whose per-member manifest is written
+# twice under distinct names — duplicate delivery, not corruption
+ENV_DUP_MANIFEST = "MXR_FAULT_FLYWHEEL_DUP_MANIFEST"
+
+CAPTURE_MANIFEST_SCHEMA = "mxr_capture_manifest"
 
 # Score thresholds used for the NMS-survivor disagreement signal: how many
 # detections survive at adjacent operating points.  A big falloff between
@@ -83,6 +109,16 @@ class CaptureOptions:
     ring_size: int = 256           # max records pending spill in memory
     shard_records: int = 32        # records per spilled shard pair
     byte_budget: int = 256 << 20   # rotate oldest shards beyond this
+    member: Optional[str] = None   # fleet member id (default: hostname)
+
+
+def member_id(member: Optional[str] = None) -> str:
+    """Filesystem-safe member id: the given member name or the local
+    hostname, with anything outside ``[A-Za-z0-9_.]`` folded to ``_`` —
+    shard and manifest names embed it, so it must never introduce a
+    path separator or break the ``shard-*`` name grammar."""
+    raw = member or socket.gethostname() or "host"
+    return re.sub(r"[^A-Za-z0-9_.]", "_", raw) or "host"
 
 
 def score_stats(records):
@@ -129,6 +165,12 @@ class RequestCapture:
         env = os.environ if env is None else env
         self._corrupt_shard = _env_index(env, ENV_CORRUPT_SHARD)
         self._truncate_spill = _env_index(env, ENV_TRUNCATE_SPILL)
+        self.member = member_id(opts.member)
+        self._dup_manifest = env.get(ENV_DUP_MANIFEST, "")
+        self._manifest_path = os.path.join(
+            opts.capture_dir,
+            "manifest-%s-%d.json" % (self.member, os.getpid()))
+        self._manifest_shards = []    # basenames of spilled shard pairs
         self._lock = threading.Lock()
         self._pending = []            # [(meta dict, uint8 pixels)]
         self._seen = 0                # submitted requests considered
@@ -201,10 +243,13 @@ class RequestCapture:
         with self._lock:
             idx = self._shard_idx
             self._shard_idx += 1
-        # pid in the name: replica children sharing one capture dir must
-        # never clobber each other's shards
-        base = os.path.join(self.opts.capture_dir,
-                            "shard-%d-%06d" % (os.getpid(), idx))
+        # member + pid in the name: replica children sharing one capture
+        # dir must never clobber each other's shards, and two FLEET
+        # members sharing the dir over a network filesystem can collide
+        # on pid alone (separate pid namespaces) — never on member+pid
+        base = os.path.join(
+            self.opts.capture_dir,
+            "shard-%s-%d-%06d" % (self.member, os.getpid(), idx))
         tel = telemetry.get()
         try:
             npz_tmp = base + ".npz.tmp"
@@ -231,10 +276,50 @@ class RequestCapture:
         with self._lock:
             self.counters["spilled_bytes"] += nbytes
             self.counters["shards"] += 1
+            self._manifest_shards.append(os.path.basename(base))
         tel.counter("flywheel/captured", len(batch))
         tel.counter("flywheel/spilled_bytes", nbytes)
         tel.counter("flywheel/shards")
+        self._write_member_manifest()
         self._rotate(keep=base)
+
+    def _write_member_manifest(self):
+        """Atomically publish this writer's manifest after every spill —
+        the fleet miner's view of what this member has delivered.  The
+        ``seq`` field lets :func:`merge_manifests` pick the newest of a
+        duplicated delivery; a write failure is counted, never raised
+        (capture must outlive a flaky manifest disk)."""
+        with self._lock:
+            doc = {
+                "schema": CAPTURE_MANIFEST_SCHEMA,
+                "version": 1,
+                "member": self.member,
+                "pid": os.getpid(),
+                "seq": len(self._manifest_shards),
+                "shards": list(self._manifest_shards),
+                "counters": dict(self.counters),
+                "rid_hi": self._rid,
+            }
+        payload = json.dumps(doc, sort_keys=True, indent=1)
+        try:
+            tmp = self._manifest_path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(payload)
+            os.replace(tmp, self._manifest_path)
+            if self._dup_manifest in (self.member, "*") \
+                    and self._dup_manifest:
+                # injected at-least-once delivery: the same manifest
+                # content lands AGAIN under a second name; the merge
+                # step must fold it to one member, not double-count
+                dup = self._manifest_path[:-len(".json")] + ".dup.json"
+                dup_tmp = dup + ".tmp"
+                with open(dup_tmp, "w") as fh:
+                    fh.write(payload)
+                os.replace(dup_tmp, dup)
+        except OSError:
+            with self._lock:
+                self.counters["spill_errors"] += 1
+            telemetry.get().counter("flywheel/spill_error")
 
     def _inject_fault(self, idx, base):
         if self._corrupt_shard == idx:
@@ -316,3 +401,55 @@ def list_shards(capture_dir):
                     "mtime": st.st_mtime})
     out.sort(key=lambda p: (p["mtime"], p["base"]))
     return out
+
+
+def list_member_manifests(capture_dir):
+    """Every parseable ``manifest-*.json`` under ``capture_dir`` —
+    duplicate deliveries included (dedup is :func:`merge_manifests`'
+    job).  Torn or unreadable files are skipped: a member whose manifest
+    write was interrupted simply has not published yet."""
+    docs = []
+    try:
+        names = sorted(os.listdir(capture_dir))
+    except OSError:
+        return docs
+    for name in names:
+        if not (name.startswith("manifest-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(capture_dir, name)) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict) \
+                or doc.get("schema") != CAPTURE_MANIFEST_SCHEMA:
+            continue
+        docs.append(doc)
+    return docs
+
+
+def merge_manifests(capture_dir):
+    """Fold per-member capture manifests into one fleet view.
+
+    Tolerant by design: absent or late members are simply not in the
+    merge yet (the next mine picks them up), torn manifests are skipped,
+    and duplicate deliveries of one member's manifest (at-least-once
+    delivery, or the injected ``MXR_FAULT_FLYWHEEL_DUP_MANIFEST``) fold
+    to a single entry — highest ``seq`` wins, duplicates counted.
+
+    Returns ``{"members": {"<member>-<pid>": doc, ...},
+    "duplicates_dropped": n}``.
+    """
+    merged, dropped = {}, 0
+    for doc in list_member_manifests(capture_dir):
+        key = "%s-%d" % (doc.get("member", "unknown"),
+                         int(doc.get("pid", 0) or 0))
+        prev = merged.get(key)
+        if prev is not None:
+            dropped += 1
+            if int(doc.get("seq", 0)) <= int(prev.get("seq", 0)):
+                continue
+        merged[key] = doc
+    if dropped:
+        telemetry.get().counter("flywheel/manifest_dup_dropped", dropped)
+    return {"members": merged, "duplicates_dropped": dropped}

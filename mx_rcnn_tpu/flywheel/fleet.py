@@ -1,0 +1,554 @@
+"""Fleet flywheel coordinator: chaos-certified continuous learning at
+fabric scale (ISSUE 17 tentpole).
+
+The single-host loop (:mod:`loop`) mines one capture dir and trusts the
+checkpoint watcher to roll the result out.  At fleet scale every stage
+gets a distributed twin, and every twin is built to converge under the
+faults the fabric already survives:
+
+* **merge** — fold the per-member capture manifests
+  (:func:`~mx_rcnn_tpu.flywheel.capture.merge_manifests`): absent/late
+  members are merged next round, duplicate deliveries dedup.
+* **mine** — a per-member ranking pass (:func:`~mx_rcnn_tpu.flywheel.
+  miner.mine_member`) folded into one global top-K
+  (:func:`~mx_rcnn_tpu.flywheel.miner.fold_rankings`).  A member
+  partitioned away mid-mine costs its contribution, never the round.
+* **train** — the replay-train subprocess; a trainer killed mid-epoch
+  fails the round and the next round retries off the same captures.
+* **promote** — the retrained generation rolls out over the PR-12
+  cross-host hot-reload path ONLY after the member-side eval-shard
+  quality gate (:func:`eval_shard_quality`, wired into
+  ``reload_engine_params``) scores the candidate no worse than the
+  incumbent — the PR-8 canary extended from "finite outputs" to a
+  measured quality delta on held-out mined records.  A rejected
+  generation leaves every member on the incumbent (the pool's
+  abort+rollback).
+* **drift** — windowed score-distribution drift vs the promoted
+  generation's training snapshot (:class:`DriftDetector`) triggers the
+  next mine instead of waiting out a fixed cadence.
+
+Promotion, rejection, and drift are first-class telemetry events
+(``flywheel/promoted`` / ``flywheel/rejected`` /
+``flywheel/drift_detected`` + flight dumps) carrying the PR-16 trace ids
+of the mined records — a promoted generation links back to the serving
+traces that taught it.
+
+Fleet fault injection (env-owned here, composed by
+``tests/faults.py:fleet_fault_env``):
+
+* ``MXR_FAULT_FLYWHEEL_PARTITION_MINE="m1"`` — the named member(s)
+  (comma-separated) become unreachable mid-mine: their ranking pass
+  raises, the fold proceeds without them.
+* ``MXR_FAULT_FLYWHEEL_KILL_TRAIN="0:0.5"`` — the round-0 trainer is
+  SIGKILLed 0.5s into its epoch (``ROUND:SECONDS``).
+* duplicate manifest delivery and corrupt capture shards live with the
+  capture code (``MXR_FAULT_FLYWHEEL_{DUP_MANIFEST,CORRUPT_SHARD}``).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.logger import logger
+
+from .capture import SCORE_BANDS, list_shards, merge_manifests, score_stats
+from .loop import run_train_cmd
+from .miner import fold_rankings, mine_member, write_manifest
+
+# Fleet fault-injection env vars (package code owns names + parsing; the
+# tests/faults.py composer only builds env dicts from these).
+ENV_PARTITION_MINE = "MXR_FAULT_FLYWHEEL_PARTITION_MINE"
+ENV_KILL_TRAIN = "MXR_FAULT_FLYWHEEL_KILL_TRAIN"
+
+EVAL_SHARD_SCHEMA = "mxr_eval_shard"
+
+# lineage breadth: how many mined trace ids ride the promotion events
+MAX_LINEAGE_TRACES = 8
+
+
+# -- eval shard: the promotion gate's held-out set --------------------------
+
+def build_eval_shard(capture_dir, entries, base_path):
+    """Materialize held-out entries into one self-contained shard pair
+    (``<base>.npz`` pixels + ``<base>.json`` rows) so the member-side
+    promotion gate scores against a frozen set instead of reaching back
+    into capture shards that rotation (or chaos) may have eaten.
+
+    Records whose pixels cannot be read back — the corrupt-capture-shard
+    injection lands exactly here — are skipped and counted: a damaged
+    member costs eval coverage, never the round.  npz before json, both
+    atomic (the capture spill discipline).  Returns
+    ``(json_path_or_None, kept, skipped)``.
+    """
+    tel = telemetry.get()
+    pixels, rows, skipped = {}, [], 0
+    for e in entries:
+        try:
+            with np.load(os.path.join(capture_dir, e["npz"])) as npz:
+                px = np.asarray(npz[e["key"]], dtype=np.uint8)
+        except Exception:  # noqa: BLE001 — torn/corrupt/missing pixels
+            skipped += 1
+            tel.counter("flywheel/eval_skipped")
+            continue
+        pixels[e["key"]] = px
+        rows.append({"key": e["key"], "rid": e["rid"],
+                     "raw_hw": e["raw_hw"], "orig_hw": e["orig_hw"],
+                     "labels": e["detections"],
+                     "trace_id": e.get("trace_id")})
+    if not rows:
+        return None, 0, skipped
+    npz_tmp = base_path + ".npz.tmp"
+    with open(npz_tmp, "wb") as fh:
+        np.savez(fh, **pixels)
+    os.replace(npz_tmp, base_path + ".npz")
+    doc = {"schema": EVAL_SHARD_SCHEMA, "version": 1,
+           "npz": os.path.basename(base_path + ".npz"),
+           "records": rows}
+    json_tmp = base_path + ".json.tmp"
+    with open(json_tmp, "w") as fh:
+        fh.write(json.dumps(doc, sort_keys=True, indent=1))
+    os.replace(json_tmp, base_path + ".json")
+    tel.counter("flywheel/eval_records", len(rows))
+    return base_path + ".json", len(rows), skipped
+
+
+def load_eval_shard(path):
+    """Load an eval shard into ``{"records": [...], "pixels": {key:
+    uint8 HWC}}``.  Raises on anything unreadable — the promotion gate
+    fails CLOSED on a torn eval shard rather than waiving the check."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != EVAL_SHARD_SCHEMA:
+        raise ValueError(f"{path}: not a {EVAL_SHARD_SCHEMA} document")
+    npz_path = os.path.join(os.path.dirname(path), doc["npz"])
+    pixels = {}
+    with np.load(npz_path) as npz:
+        for rec in doc["records"]:
+            pixels[rec["key"]] = np.asarray(npz[rec["key"]], np.uint8)
+    return {"records": doc["records"], "pixels": pixels}
+
+
+def _iou(a, b):
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    if inter <= 0:
+        return 0.0
+    area = ((a[2] - a[0]) * (a[3] - a[1])
+            + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / max(area, 1e-9)
+
+
+def detection_agreement(preds, labels, iou_thresh=0.5, score_floor=0.1,
+                        label_floor=0.3):
+    """F1-style agreement in ``[0, 1]`` between served detections and a
+    record's pseudo-labels: greedy same-class IoU matching, each label
+    matched at most once.  Both empty → 1.0 (nothing to disagree
+    about); one empty → 0.0.  ``label_floor`` mirrors the miner's
+    ``min_label_score`` so weak captured detections don't count as
+    ground truth."""
+    preds = [p for p in preds if float(p["score"]) >= score_floor]
+    labels = [g for g in labels if float(g["score"]) >= label_floor]
+    if not preds and not labels:
+        return 1.0
+    if not preds or not labels:
+        return 0.0
+    used, matched = set(), 0
+    for p in sorted(preds, key=lambda r: -float(r["score"])):
+        best, best_iou = None, iou_thresh
+        for i, g in enumerate(labels):
+            if i in used or int(g["cls"]) != int(p["cls"]):
+                continue
+            ov = _iou(p["bbox"], g["bbox"])
+            if ov >= best_iou:
+                best, best_iou = i, ov
+        if best is not None:
+            used.add(best)
+            matched += 1
+    return 2.0 * matched / (len(preds) + len(labels))
+
+
+def eval_shard_quality(engine, shard, timeout_s=30.0):
+    """Mean detection agreement of the CURRENT weights over an eval
+    shard — the measured stand-in for mAP the promotion gate compares
+    between incumbent and candidate.  Pixels are replayed at their
+    captured raw extent; pseudo-labels (stored in ORIGINAL coords, like
+    every served detection) are scaled to that extent first, the
+    ReplayDataset coordinate convention."""
+    futs = []
+    for rec in shard["records"]:
+        px = shard["pixels"][rec["key"]]
+        rh, rw = rec["raw_hw"]
+        futs.append((rec, engine.submit(
+            np.ascontiguousarray(px[:rh, :rw]))))
+    vals = []
+    for rec, fut in futs:
+        dets = fut.result(timeout=timeout_s) or []
+        rh, rw = rec["raw_hw"]
+        oh, ow = rec["orig_hw"]
+        sy, sx = rh / max(oh, 1), rw / max(ow, 1)
+        labels = [dict(g, bbox=[g["bbox"][0] * sx, g["bbox"][1] * sy,
+                                g["bbox"][2] * sx, g["bbox"][3] * sy])
+                  for g in rec["labels"]]
+        vals.append(detection_agreement(dets, labels))
+    return float(np.mean(vals)) if vals else 0.0
+
+
+# -- drift: when the traffic leaves the training snapshot behind -----------
+
+def score_distribution(stats_list):
+    """Summary of a set of per-record score stats: mean of mean_score
+    and entropy, plus the fraction of records with at least one survivor
+    in each score band."""
+    n = max(len(stats_list), 1)
+    out = {"mean_score": 0.0, "entropy": 0.0}
+    bands = {f"{t:.1f}": 0.0 for t in SCORE_BANDS}
+    for s in stats_list:
+        out["mean_score"] += float(s.get("mean_score", 0.0)) / n
+        out["entropy"] += float(s.get("entropy", 0.0)) / n
+        sb = s.get("bands", {})
+        for k in bands:
+            bands[k] += (1.0 / n) if sb.get(k, 0) > 0 else 0.0
+    out["bands"] = bands
+    return out
+
+
+def drift_metric(ref, cur):
+    """Max absolute difference across the distribution summaries —
+    one number an operator can threshold."""
+    diffs = [abs(ref["mean_score"] - cur["mean_score"]),
+             abs(ref["entropy"] - cur["entropy"])]
+    for k in ref.get("bands", {}):
+        diffs.append(abs(ref["bands"].get(k, 0.0)
+                         - cur.get("bands", {}).get(k, 0.0)))
+    return max(diffs) if diffs else 0.0
+
+
+class DriftDetector:
+    """Windowed score-distribution drift vs the training snapshot.
+
+    ``snapshot()`` freezes the distribution the promoted generation was
+    trained on (the fold's entries); ``observe()`` feeds per-record
+    stats captured since.  ``check()`` compares the recent window
+    against the snapshot — a metric above ``threshold`` means the
+    traffic has moved and the next mine should fire now, not at the
+    next fixed cadence."""
+
+    def __init__(self, threshold=0.25, window=64, min_observed=8):
+        self.threshold = float(threshold)
+        self.min_observed = int(min_observed)
+        self._window = collections.deque(maxlen=int(window))
+        self._ref = None
+
+    def snapshot(self, stats_list):
+        self._ref = score_distribution(list(stats_list))
+        self._window.clear()
+        return self._ref
+
+    def observe(self, stats):
+        self._window.append(stats)
+
+    def check(self):
+        """(drifted, metric) — False until a snapshot exists and the
+        window has enough mass to mean anything."""
+        if self._ref is None or len(self._window) < self.min_observed:
+            return False, 0.0
+        metric = drift_metric(self._ref,
+                              score_distribution(list(self._window)))
+        return metric > self.threshold, metric
+
+
+# -- the coordinator -------------------------------------------------------
+
+class FleetFlywheel:
+    """One continuous-learning loop over a fleet: merge → per-member
+    mine → fold → train → gated promotion → drift watch.
+
+    ``rollout_fn(target) -> bool`` rolls the candidate fleet-wide
+    (default: POST ``/admin/reload`` to ``promote_to``, i.e. the fabric
+    router — the pool's rolling reload with abort+rollback);
+    ``candidate_fn() -> target|None`` discovers the retrained
+    checkpoint (default: ``scan_checkpoints(ckpt_prefix)``).  Both are
+    injectable, the fabric's fake-clock test discipline."""
+
+    def __init__(self, capture_dir: str, top_k: int = 64,
+                 min_label_score: float = 0.3,
+                 out_dir: Optional[str] = None,
+                 train_cmd: Optional[Sequence[str]] = None,
+                 ckpt_prefix: Optional[str] = None,
+                 promote_to: Optional[str] = None,
+                 rollout_fn: Optional[Callable[[dict], bool]] = None,
+                 candidate_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 eval_every: int = 4, quality_slack: float = 0.0,
+                 drift_threshold: float = 0.25, drift_window: int = 64,
+                 env: Optional[dict] = None):
+        self.capture_dir = capture_dir
+        self.top_k = top_k
+        self.min_label_score = min_label_score
+        self.out_dir = out_dir
+        self.train_cmd = list(train_cmd) if train_cmd else None
+        self.ckpt_prefix = ckpt_prefix
+        self.promote_to = promote_to
+        self.rollout_fn = rollout_fn or self._default_rollout
+        self.candidate_fn = candidate_fn or self._default_candidate
+        self.eval_every = int(eval_every)
+        self.quality_slack = float(quality_slack)
+        self.drift = DriftDetector(drift_threshold, drift_window)
+        self.promoted_rounds = 0
+        self._last_candidate_key = None
+        env = os.environ if env is None else env
+        self._partitioned = {m.strip() for m in
+                             env.get(ENV_PARTITION_MINE, "").split(",")
+                             if m.strip()}
+        self._kill_round, self._kill_after_s = self._parse_kill(
+            env.get(ENV_KILL_TRAIN, ""))
+
+    @staticmethod
+    def _parse_kill(raw):
+        if not raw:
+            return None, None
+        rnd, _, secs = raw.partition(":")
+        try:
+            return int(rnd), float(secs or 0.0)
+        except ValueError:
+            logger.warning("bad %s value %r (want ROUND:SECONDS)",
+                           ENV_KILL_TRAIN, raw)
+            return None, None
+
+    # -- default candidate discovery / rollout wiring ---------------------
+
+    def _default_candidate(self):
+        from mx_rcnn_tpu.serve.replica import scan_checkpoints, target_key
+        if not self.ckpt_prefix:
+            return None
+        tgt = scan_checkpoints(self.ckpt_prefix)
+        if tgt is None:
+            return None
+        key = target_key(tgt)
+        if self._last_candidate_key is not None \
+                and key <= self._last_candidate_key:
+            return None  # nothing newer than what already rolled out
+        return tgt
+
+    def _default_rollout(self, target):
+        from mx_rcnn_tpu.serve.frontend import address_request
+        if not self.promote_to:
+            logger.warning("fleet flywheel: no rollout path configured "
+                           "(promote_to/rollout_fn)")
+            return False
+        status, doc = address_request(self.promote_to, "POST",
+                                      "/admin/reload", doc=target,
+                                      timeout=600.0)
+        return status == 200 and bool(
+            isinstance(doc, dict) and doc.get("ok", True))
+
+    # -- one round --------------------------------------------------------
+
+    def mine_round(self, round_idx: int = 0) -> dict:
+        """merge → per-member mine (partition-tolerant) → fold → commit
+        manifest + eval shard.  Returns the mine summary."""
+        tel = telemetry.get()
+        merged = merge_manifests(self.capture_dir)
+        rankings, failed = [], []
+        for key in sorted(merged["members"]):
+            mdoc = merged["members"][key]
+            member = mdoc.get("member", "unknown")
+            try:
+                if member in self._partitioned:
+                    raise OSError(f"injected partition: member "
+                                  f"{member} unreachable mid-mine")
+                rankings.append(mine_member(
+                    self.capture_dir, mdoc, top_k=self.top_k,
+                    min_label_score=self.min_label_score))
+            except (OSError, ValueError) as e:
+                failed.append(member)
+                tel.counter("flywheel/mine_member_failed")
+                tel.dump_flight("mine_member_failed", member=member,
+                                round=round_idx, cause=str(e))
+                logger.warning("fleet mine round %d: member %s failed "
+                               "(%s) — folding without it", round_idx,
+                               member, e)
+        train, evals, scanned, skipped = fold_rankings(
+            rankings, top_k=self.top_k, eval_every=self.eval_every)
+        summary = {"round": round_idx, "mined": len(train),
+                   "eval": len(evals), "scanned": scanned,
+                   "skipped": skipped,
+                   "members": sorted(r["member"] for r in rankings),
+                   "mine_failed": sorted(failed),
+                   "duplicates_dropped": merged["duplicates_dropped"],
+                   "manifest": None, "eval_shard": None}
+        if not train:
+            logger.info("fleet mine round %d: nothing mined (%d members,"
+                        " %d scanned)", round_idx, len(rankings), scanned)
+            return summary
+        manifest = write_manifest(
+            self.capture_dir, train, scanned, self.top_k,
+            out_dir=self.out_dir, min_label_score=self.min_label_score,
+            extra={"members": summary["members"],
+                   "eval_entries": evals})
+        summary["manifest"] = manifest
+        if evals:
+            shard_path, kept, dropped = build_eval_shard(
+                self.capture_dir, evals,
+                manifest[:-len(".json")] + "-eval")
+            summary["eval_shard"] = shard_path
+            summary["eval"] = kept
+            if dropped:
+                logger.warning("fleet mine round %d: %d eval record(s) "
+                               "unreadable (corrupt capture shard?) — "
+                               "gating on the %d readable", round_idx,
+                               dropped, kept)
+        tel.gauge("flywheel/round", round_idx)
+        logger.info("fleet mine round %d: %d member(s) -> %d train + %d "
+                    "eval of %d scanned -> %s", round_idx, len(rankings),
+                    len(train), summary["eval"], scanned,
+                    os.path.basename(manifest))
+        return summary
+
+    def _lineage(self, manifest_path):
+        """The first few trace ids riding the mined entries — promotion
+        events link the new generation back to the requests that taught
+        it (PR-16 provenance)."""
+        try:
+            with open(manifest_path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return []
+        tids = [e["trace_id"] for e in doc.get("entries", [])
+                if e.get("trace_id")]
+        return tids[:MAX_LINEAGE_TRACES]
+
+    def run_round(self, round_idx: int = 0) -> dict:
+        """One full fleet round: mine, train, gated promotion.  A failed
+        train (chaos kill, OOM) or rejected promotion leaves
+        ``promoted=False``; the captures are still on disk, so the next
+        round retries the whole stage chain."""
+        tel = telemetry.get()
+        summary = self.mine_round(round_idx)
+        summary.update({"train_rc": None, "promoted": False})
+        if not summary["manifest"]:
+            return summary
+        if self.train_cmd:
+            kill_s = (self._kill_after_s
+                      if round_idx == self._kill_round else None)
+            rc = run_train_cmd(self.train_cmd, summary["manifest"],
+                               kill_after_s=kill_s)
+            summary["train_rc"] = rc
+            if rc != 0:
+                tel.counter("flywheel/train_failed")
+                tel.dump_flight("fleet_train_failed", round=round_idx,
+                                rc=rc)
+                logger.error("fleet round %d: train rc=%d — generation "
+                             "not promoted, retrying next round",
+                             round_idx, rc)
+                return summary
+        candidate = self.candidate_fn()
+        if candidate is None:
+            summary["error"] = "no candidate checkpoint"
+            logger.warning("fleet round %d: no candidate checkpoint to "
+                           "promote", round_idx)
+            return summary
+        target = dict(candidate)
+        if summary["eval_shard"]:
+            target["eval_shard"] = summary["eval_shard"]
+            target["quality_slack"] = self.quality_slack
+        lineage = self._lineage(summary["manifest"])
+        if lineage:
+            target["trace_ids"] = lineage
+        ok = bool(self.rollout_fn(target))
+        summary["promoted"] = ok
+        if ok:
+            self.promoted_rounds += 1
+            self._last_candidate_key = (candidate["epoch"],
+                                        candidate["consumed"],
+                                        candidate["kind"])
+            tel.counter("flywheel/promoted")
+            tel.dump_flight("generation_promoted", round=round_idx,
+                            target=[candidate["epoch"],
+                                    candidate["consumed"],
+                                    candidate["kind"]],
+                            manifest=os.path.basename(summary["manifest"]),
+                            members=summary["members"],
+                            trace_ids=lineage)
+            self._snapshot_from_manifest(summary["manifest"])
+            logger.info("fleet round %d: generation PROMOTED fleet-wide "
+                        "(%d member(s) mined, lineage %d trace(s))",
+                        round_idx, len(summary["members"]), len(lineage))
+        else:
+            tel.counter("flywheel/rejected")
+            tel.dump_flight("generation_rejected", round=round_idx,
+                            target=[candidate.get("epoch"),
+                                    candidate.get("consumed"),
+                                    candidate.get("kind")],
+                            trace_ids=lineage)
+            logger.error("fleet round %d: promotion REJECTED — every "
+                         "member stays on the incumbent", round_idx)
+        return summary
+
+    def _snapshot_from_manifest(self, manifest_path):
+        """Freeze the promoted generation's training score distribution
+        as the drift reference (stats recomputed from the entries'
+        captured detections)."""
+        try:
+            with open(manifest_path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return
+        stats = [score_stats(e.get("detections", []))
+                 for e in doc.get("entries", [])]
+        if stats:
+            self.drift.snapshot(stats)
+
+    def check_drift(self, window: int = 64) -> tuple:
+        """Feed the newest captured rows into the drift window and
+        compare against the training snapshot.  Drift is a first-class
+        event: counted, flight-dumped, and the run loop treats it as
+        the trigger for the next mine."""
+        rows = []
+        for shard in list_shards(self.capture_dir)[-8:]:
+            try:
+                with open(shard["jsonl"]) as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if line:
+                            try:
+                                rows.append(json.loads(line))
+                            except ValueError:
+                                continue
+            except OSError:
+                continue
+        for row in rows[-window:]:
+            self.drift.observe(row.get("stats", {}))
+        drifted, metric = self.drift.check()
+        if drifted:
+            telemetry.get().counter("flywheel/drift_detected")
+            telemetry.get().dump_flight(
+                "flywheel_drift", metric=round(metric, 4),
+                threshold=self.drift.threshold)
+            logger.warning("fleet flywheel: score distribution DRIFTED "
+                           "%.3f past the training snapshot (threshold "
+                           "%.3f) — next mine triggered", metric,
+                           self.drift.threshold)
+        return drifted, metric
+
+    def run(self, max_rounds: int = 3) -> list:
+        """Round until a generation promotes (convergence under chaos:
+        a killed trainer or partitioned miner costs rounds, not the
+        loop), then keep going only while drift says the world moved."""
+        results = []
+        for i in range(max_rounds):
+            summary = self.run_round(i)
+            results.append(summary)
+            if summary["promoted"]:
+                drifted, metric = self.check_drift()
+                summary["drift"] = {"drifted": drifted,
+                                    "metric": round(metric, 4)}
+                if not drifted:
+                    break
+        return results

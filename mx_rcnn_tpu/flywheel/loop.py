@@ -18,6 +18,27 @@ from mx_rcnn_tpu.logger import logger
 from .miner import mine_shards, write_manifest
 
 
+def run_train_cmd(train_cmd, manifest, kill_after_s=None):
+    """Launch the replay-train subprocess and return its rc.
+
+    ``kill_after_s`` is the kill-trainer-mid-epoch chaos injection: the
+    child is SIGKILLed after that many seconds unless it finished first
+    — the loop code owns the kill so the fault lands deterministically
+    in the chosen round (a negative rc, exactly what a preempted or
+    OOM-killed trainer reports)."""
+    cmd = list(train_cmd) + ["--replay-manifest", manifest]
+    proc = subprocess.Popen(cmd)
+    if kill_after_s is not None:
+        try:
+            return proc.wait(timeout=kill_after_s)
+        except subprocess.TimeoutExpired:
+            logger.warning("FAULT flywheel: SIGKILL trainer pid %d after "
+                           "%.2fs mid-epoch", proc.pid, kill_after_s)
+            proc.kill()
+            return proc.wait()
+    return proc.wait()
+
+
 class FlywheelLoop:
     def __init__(self, capture_dir: str, top_k: int = 64,
                  min_label_score: float = 0.3,
@@ -50,13 +71,12 @@ class FlywheelLoop:
         logger.info("flywheel round %d: mined %d/%d -> %s",
                     round_idx, len(entries), scanned, manifest)
         if self.train_cmd:
-            cmd = self.train_cmd + ["--replay-manifest", manifest]
-            proc = subprocess.run(cmd)
-            result["train_rc"] = proc.returncode
-            if proc.returncode != 0:
+            rc = run_train_cmd(self.train_cmd, manifest)
+            result["train_rc"] = rc
+            if rc != 0:
                 tel.counter("flywheel/train_failed")
                 logger.error("flywheel round %d: train rc=%d",
-                             round_idx, proc.returncode)
+                             round_idx, rc)
         return result
 
     def run(self, rounds: int = 1) -> list:

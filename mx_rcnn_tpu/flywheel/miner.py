@@ -19,6 +19,16 @@ Hardness combines the three signals the capture stage recorded:
 The manifest rename is the commit point: a SIGTERM mid-mine leaves only a
 ``.tmp`` file behind, never a partial manifest (pinned in tests via
 :data:`ENV_MINE_PAUSE_S`, which sleeps between write and rename).
+
+Distributed mine (ISSUE 17): at fleet scale the mine splits into a
+per-member ranking pass (:func:`mine_member` — each member's shards are
+read off its own capture manifest, so a member still spilling can't tear
+the scan) and a fold (:func:`fold_rankings`) that merges the rankings
+into one global top-K with cross-member dedup.  The fold's total order is
+deterministic in ANY member order — hardness desc, then rid asc (the
+tie-break), then (npz, key) as a final anchor — so re-folding after a
+partition heals lands on the byte-identical manifest, committed through
+the same ``mined-<digest>.json`` rename point.
 """
 
 import hashlib
@@ -29,6 +39,8 @@ import time
 from mx_rcnn_tpu import telemetry
 
 from .capture import SCORE_BANDS, list_shards
+
+MEMBER_RANKING_SCHEMA = "mxr_member_ranking"
 
 # Test hook: sleep this many seconds between writing the tmp manifest and
 # the atomic rename, widening the window a SIGTERM-atomicity test needs.
@@ -55,18 +67,25 @@ def hardness(stats):
                    "low_max": low_max}
 
 
-def mine_shards(capture_dir, top_k=64, min_label_score=0.3):
+def mine_shards(capture_dir, top_k=64, min_label_score=0.3, shards=None,
+                member=None):
     """Scan shard rows, rank by hardness, return (entries, scanned, skipped).
 
     Records with no detection at or above ``min_label_score`` carry no
     usable pseudo-label and are skipped (counted, not errored).  Rows that
     fail to parse are skipped the same way — a torn jsonl must not kill
     the mine.
+
+    ``shards`` restricts the scan to an explicit shard list (the fleet
+    path mines exactly what a member's manifest names); ``member`` tags
+    each entry with its source member — the single-host path passes
+    neither, so its entries (and therefore its manifest bytes) are
+    untouched by fleet mode.
     """
     tel = telemetry.get()
     scanned = skipped = 0
     scored = []
-    for shard in list_shards(capture_dir):
+    for shard in (list_shards(capture_dir) if shards is None else shards):
         with open(shard["jsonl"]) as fh:
             for line in fh:
                 line = line.strip()
@@ -85,7 +104,7 @@ def mine_shards(capture_dir, top_k=64, min_label_score=0.3):
                     tel.counter("flywheel/skipped_unlabeled")
                     continue
                 score, signals = hardness(row.get("stats", {}))
-                scored.append((score, {
+                entry = {
                     "shard": os.path.basename(shard["jsonl"]),
                     "npz": row["npz"],
                     "key": row["key"],
@@ -98,20 +117,113 @@ def mine_shards(capture_dir, top_k=64, min_label_score=0.3):
                     "raw_hw": row["raw_hw"],
                     "orig_hw": row["orig_hw"],
                     "detections": dets,
-                }))
+                }
+                if member is not None:
+                    entry["member"] = member
+                scored.append((score, entry))
     # stable, deterministic order: hardness desc, then rid asc
     scored.sort(key=lambda se: (-se[0], se[1]["rid"]))
     entries = [e for _, e in scored[:top_k]]
-    tel.counter("flywheel/mined", len(entries))
+    if member is None:
+        # a member-tagged scan is an intermediate ranking; the FOLD
+        # counts what was actually mined, so the counter isn't inflated
+        # by per-member passes over overlapping hard sets
+        tel.counter("flywheel/mined", len(entries))
     return entries, scanned, skipped
 
 
+def mine_member(capture_dir, manifest_doc, top_k=64, min_label_score=0.3):
+    """One member's ranking pass: scan exactly the shards its capture
+    manifest names (not a dir glob other members are mutating), rank,
+    and return a ranking doc for :func:`fold_rankings`.  Shards the
+    manifest names but the dir no longer holds (byte-budget rotation, a
+    corrupted-and-removed pair) are skipped and counted — a member's
+    stale claim costs coverage, never the mine."""
+    tel = telemetry.get()
+    member = manifest_doc.get("member", "unknown")
+    shards, missing = [], 0
+    for name in manifest_doc.get("shards", []):
+        base = os.path.join(capture_dir, name)
+        try:
+            st = os.stat(base + ".jsonl")
+            nbytes = os.path.getsize(base + ".npz") + st.st_size
+        except OSError:
+            missing += 1
+            tel.counter("flywheel/shard_missing")
+            continue
+        shards.append({"base": base, "npz": base + ".npz",
+                       "jsonl": base + ".jsonl", "bytes": nbytes,
+                       "mtime": st.st_mtime})
+    shards.sort(key=lambda p: (p["mtime"], p["base"]))
+    entries, scanned, skipped = mine_shards(
+        capture_dir, top_k=top_k, min_label_score=min_label_score,
+        shards=shards, member=member)
+    return {"schema": MEMBER_RANKING_SCHEMA, "member": member,
+            "pid": manifest_doc.get("pid"), "entries": entries,
+            "scanned": scanned, "skipped": skipped,
+            "missing_shards": missing}
+
+
+def fold_rankings(rankings, top_k=64, eval_every=0):
+    """Fold per-member rankings into one global top-K.
+
+    Cross-member dedup on ``(npz, key)``: the same captured record
+    arriving through two rankings (duplicate manifest delivery) ranks
+    once.  The total order is deterministic regardless of fold order —
+    hardness desc, rid asc (the cross-member tie-break), then
+    ``(npz, key)`` as a final anchor so equal-rid records from different
+    members cannot flip between runs.
+
+    With ``eval_every > 0`` every ``eval_every``-th record of the ranked
+    stream is RESERVED as a held-out eval entry for the promotion gate —
+    never trained on, so the gate scores generalization, not
+    memorization.
+
+    Returns ``(train_entries, eval_entries, scanned, skipped)``.
+    """
+    pool = {}
+    scanned = skipped = 0
+    for r in rankings:
+        if not r:
+            continue
+        scanned += int(r.get("scanned", 0))
+        skipped += int(r.get("skipped", 0))
+        for e in r.get("entries", []):
+            ident = (e["npz"], e["key"])
+            prev = pool.get(ident)
+            # duplicate across rankings (re-delivered manifest): keep
+            # the canonically-smallest member tag, NOT first-seen —
+            # first-seen would leak fold order into the manifest bytes
+            if prev is None or (e.get("member") or "") \
+                    < (prev.get("member") or ""):
+                pool[ident] = e
+    pool = sorted(pool.values(),
+                  key=lambda e: (-e["hardness"], e["rid"],
+                                 e["npz"], e["key"]))
+    train, evals, taken = [], [], 0
+    for e in pool:
+        if len(train) >= top_k:
+            break
+        taken += 1
+        if eval_every and taken % eval_every == 0:
+            evals.append(e)
+        else:
+            train.append(e)
+    telemetry.get().counter("flywheel/mined", len(train))
+    return train, evals, scanned, skipped
+
+
 def write_manifest(capture_dir, entries, scanned, top_k,
-                   out_dir=None, min_label_score=None):
+                   out_dir=None, min_label_score=None, extra=None):
     """Atomically write ``mined-<digest>.json``; returns its path.
 
     The digest covers the entry provenance, so re-mining identical
-    captures lands on the same filename (idempotent rounds).
+    captures lands on the same filename (idempotent rounds) — fleet
+    re-folds after a healed partition commit through this same rename
+    point.  ``extra`` adds fleet-mode keys (``members``,
+    ``eval_entries``) strictly ADDITIVELY: it may not shadow a legacy
+    key, and the single-host path passes none, keeping its manifest
+    byte-for-byte unchanged.
     """
     doc = {
         "schema": MANIFEST_SCHEMA,
@@ -122,6 +234,11 @@ def write_manifest(capture_dir, entries, scanned, top_k,
         "min_label_score": min_label_score,
         "entries": entries,
     }
+    for key, value in (extra or {}).items():
+        if key in doc:
+            raise ValueError(f"extra manifest key {key!r} shadows a "
+                             f"legacy field")
+        doc[key] = value
     payload = json.dumps(doc, sort_keys=True, indent=1)
     digest = hashlib.sha256(json.dumps(
         [(e["npz"], e["key"]) for e in entries]).encode()).hexdigest()[:12]

@@ -360,7 +360,8 @@ class ReplicaPool:
                          "breaker_open": 0, "hedge_fired": 0,
                          "hedge_won": 0, "retry": 0, "retry_ok": 0,
                          "retry_budget_exhausted": 0, "no_ready": 0,
-                         "transport_error": 0, "requests": 0}
+                         "transport_error": 0, "requests": 0,
+                         "quality_rejected": 0}
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -702,6 +703,11 @@ class ReplicaPool:
                             doc.get("generation"),
                             doc.get("recompiles_during_swap"))
                 return True
+            if isinstance(doc, dict) and "quality_candidate" in doc:
+                # the member-side promotion gate measured the candidate
+                # below the incumbent — distinct from a canary/transport
+                # rejection so a stalled flywheel is diagnosable
+                self.count("quality_rejected")
             logger.error("fabric: member %s reload rejected (%s): %s",
                          m.name, status,
                          doc.get("error", doc) if isinstance(doc, dict)
@@ -770,6 +776,14 @@ class ReplicaPool:
             return True
 
     # -- introspection ---------------------------------------------------
+
+    def member_generations(self) -> dict:
+        """``{name: generation}`` for every member — the fleet flywheel's
+        convergence check: after a promotion all values equal the pool
+        generation; after a rejection none moved."""
+        with self._lock:
+            return {m.name: int(m.generation)
+                    for m in self.members.values()}
 
     def metrics(self, now: Optional[float] = None) -> dict:
         now = time.monotonic() if now is None else now

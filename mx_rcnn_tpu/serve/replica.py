@@ -259,6 +259,21 @@ def poison_params(params):
 
 # -- checkpoint discovery (PR-2 layout, no orbax import) -------------------
 
+def _committed_dir(path: str) -> bool:
+    """True when an int-named checkpoint dir holds at least one
+    committed (non-tmp) entry.  A trainer killed mid-save can leave the
+    dir itself behind empty, or holding only ``*tmp*`` payload still
+    being staged — selecting either would hand the watcher a target
+    whose load fails and lands on the bad list, burning the generation.
+    The dir vanishing between listdir and this check (concurrent
+    cleanup) is just not-committed."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return False
+    return any("tmp" not in n for n in names)
+
+
 def scan_checkpoints(prefix: str) -> Optional[dict]:
     """Newest committed checkpoint under ``prefix`` as a reload target
     ``{"prefix", "kind", "epoch", "consumed"}`` — epoch dirs
@@ -266,7 +281,9 @@ def scan_checkpoints(prefix: str) -> Optional[dict]:
     furthest position winning exactly like ``latest_resume_point`` (a
     finished epoch beats its own mid-epoch saves).  Pure listdir — orbax
     commits by atomic rename, so an int-named dir is a committed save
-    and in-progress ``*.orbax-checkpoint-tmp*`` names never int-parse."""
+    and in-progress ``*.orbax-checkpoint-tmp*`` names never int-parse;
+    :func:`_committed_dir` additionally skips the husk a trainer killed
+    mid-save leaves behind (empty or tmp-only int dir)."""
     if not os.path.isdir(prefix):
         return None
     cands = []
@@ -275,7 +292,8 @@ def scan_checkpoints(prefix: str) -> Optional[dict]:
             e = int(name)
         except ValueError:
             continue
-        if os.path.isdir(os.path.join(prefix, name)):
+        p = os.path.join(prefix, name)
+        if os.path.isdir(p) and _committed_dir(p):
             cands.append((e, 0, "epoch"))
     steps_dir = os.path.join(prefix, "steps")
     if os.path.isdir(steps_dir):
@@ -284,7 +302,8 @@ def scan_checkpoints(prefix: str) -> Optional[dict]:
                 key = int(name)
             except ValueError:
                 continue
-            if os.path.isdir(os.path.join(steps_dir, name)):
+            p = os.path.join(steps_dir, name)
+            if os.path.isdir(p) and _committed_dir(p):
                 e, c = decode_step_key(key)
                 cands.append((e, c, "step"))
     if not cands:
@@ -448,10 +467,40 @@ def reload_engine_params(engine, predictor, cfg, target: dict,
     previous weights are restored verbatim (the exact pre-swap leaves,
     so rollback itself is also recompile-free) and the engine resumes
     serving them.  ``info["recompiles_during_swap"]`` pins the PR-7
-    registry-reuse contract: 0 in steady state."""
+    registry-reuse contract: 0 in steady state.
+
+    A target carrying ``eval_shard`` (the fleet promotion gate, ISSUE
+    17) additionally must BEAT the incumbent: the incumbent's mean
+    detection agreement over the held-out shard is measured before the
+    swap, the candidate's after, and a candidate scoring below
+    ``incumbent - quality_slack`` is rolled back exactly like a canary
+    failure — the PR-8 "finite outputs" canary extended to a measured
+    quality delta.  An unreadable eval shard fails CLOSED (no swap at
+    all).  The generation only advances on acceptance, so a rejected
+    candidate can be retried by a later, better save.  The fabric
+    unroutes a member for the whole reload, so gate probes are the only
+    requests the candidate ever answers on a rejected promotion."""
     tel = telemetry.get()
     t0 = time.monotonic()
     gen = int(target.get("generation", engine.generation + 1))
+    shard = quality_incumbent = None
+    if target.get("eval_shard"):
+        from mx_rcnn_tpu.flywheel.fleet import (eval_shard_quality,
+                                                load_eval_shard)
+        try:
+            shard = load_eval_shard(target["eval_shard"])
+        except (OSError, ValueError, KeyError) as e:
+            tel.counter("flywheel/promotion_gate_reject")
+            tel.dump_flight("promotion_rejected", generation=gen,
+                            target=list(target_key(target)),
+                            cause=f"eval shard unreadable: {e}",
+                            trace_ids=target.get("trace_ids") or [])
+            logger.error("promotion of %s REJECTED: eval shard "
+                         "unreadable (%s) — gate fails closed",
+                         target_key(target), e)
+            return False, {"error": f"eval shard unreadable: {e}",
+                           "rolled_back": False}
+        quality_incumbent = eval_shard_quality(engine, shard)
     if not engine.drain(timeout=RELOAD_DRAIN_TIMEOUT_S):
         engine.resume()
         return False, {"error": "drain timed out — dispatcher wedged?",
@@ -487,6 +536,39 @@ def reload_engine_params(engine, predictor, cfg, target: dict,
                        "rolled_back": True}
     finally:
         engine.resume()
+    quality_candidate = None
+    if shard is not None:
+        from mx_rcnn_tpu.flywheel.fleet import eval_shard_quality
+        slack = float(target.get("quality_slack", 0.0))
+        quality_candidate = eval_shard_quality(engine, shard)
+        if quality_candidate + 1e-9 < quality_incumbent - slack:
+            engine.drain(timeout=RELOAD_DRAIN_TIMEOUT_S)
+            try:
+                if old is not None:
+                    predictor.params = old
+            finally:
+                engine.resume()
+            tel.counter("serve/reload_rollback")
+            tel.counter("flywheel/promotion_gate_reject")
+            tel.dump_flight("promotion_rejected", generation=gen,
+                            target=list(target_key(target)),
+                            quality_candidate=round(quality_candidate, 4),
+                            quality_incumbent=round(quality_incumbent, 4),
+                            quality_slack=slack,
+                            trace_ids=target.get("trace_ids") or [])
+            logger.error("promotion of %s REJECTED by quality gate "
+                         "(candidate %.4f < incumbent %.4f - slack %.4f)"
+                         " — rolled back to generation %d",
+                         target_key(target), quality_candidate,
+                         quality_incumbent, slack, engine.generation)
+            return False, {"error": "quality gate: candidate %.4f < "
+                                    "incumbent %.4f - slack %.4f"
+                                    % (quality_candidate,
+                                       quality_incumbent, slack),
+                           "rolled_back": True,
+                           "quality_candidate": quality_candidate,
+                           "quality_incumbent": quality_incumbent}
+        tel.counter("flywheel/promotion_gate_pass")
     with engine._lock:
         engine.generation = max(engine.generation, gen)
     swap_recompiles = engine.counters["recompiles"] - recompiles_before
@@ -496,10 +578,14 @@ def reload_engine_params(engine, predictor, cfg, target: dict,
     logger.info("hot reload: generation %d live from %s in %.2fs "
                 "(%d recompile(s) during swap)", engine.generation,
                 target_key(target), wall, swap_recompiles)
-    return True, {"generation": engine.generation,
-                  "target": list(target_key(target)),
-                  "wall_s": round(wall, 3),
-                  "recompiles_during_swap": swap_recompiles}
+    info = {"generation": engine.generation,
+            "target": list(target_key(target)),
+            "wall_s": round(wall, 3),
+            "recompiles_during_swap": swap_recompiles}
+    if shard is not None:
+        info["quality_candidate"] = quality_candidate
+        info["quality_incumbent"] = quality_incumbent
+    return True, info
 
 
 def make_reloader(engine, predictor, cfg, load_params_fn=None,

@@ -85,6 +85,18 @@ FLYWHEEL_COUNTERS = (
     "flywheel/skipped_bad_row",
     "flywheel/replayed",
     "flywheel/train_failed",
+    # fleet mode (flywheel/fleet.py): merge/mine fault tolerance and the
+    # gated-promotion loop — "did the fleet converge to a promoted
+    # generation, and what did chaos cost?" in the same block
+    "flywheel/shard_missing",
+    "flywheel/manifest_dup_dropped",
+    "flywheel/mine_member_failed",
+    "flywheel/eval_skipped",
+    "flywheel/promotion_gate_pass",
+    "flywheel/promotion_gate_reject",
+    "flywheel/promoted",
+    "flywheel/rejected",
+    "flywheel/drift_detected",
 )
 
 # the multi-model pool's paging + cross-model scheduling health

@@ -1,0 +1,236 @@
+#!/usr/bin/env bash
+# Fleet-flywheel smoke (ISSUE 17, CPU-friendly): chaos-certified
+# continuous learning at fabric scale, end to end through the real CLI
+# drivers.
+#
+#   1. Fabric up — one router plus TWO standalone TCP members that
+#      self-register with --join, both spilling request captures into
+#      ONE shared capture dir (--capture-dir + --capture-member, the
+#      member+pid shard grammar).  Member m0 runs with the
+#      MXR_FAULT_FLYWHEEL_DUP_MANIFEST injection: every capture
+#      manifest it publishes is delivered TWICE (the at-least-once
+#      shape the merge must fold to one member entry).
+#   2. Traffic — scripts/loadgen.py drives the router until both
+#      members have spilled shards; the pre-promotion generation is
+#      snapshotted off the router's /metrics.
+#   3. Fleet round — flywheel.py fleet merges the per-member manifests
+#      (duplicates dropped, not double-counted), folds the per-member
+#      rankings into one global top-K with held-out eval entries,
+#      replay-trains a real checkpoint into --ckpt-prefix, and promotes
+#      it fleet-wide over the router's /admin/reload GATED on the
+#      eval-shard quality check (generous --quality-slack: the
+#      incumbent authored the pseudo-labels, the gate machinery — not
+#      a tight delta — is what this smoke certifies).
+#   4. Certify — generation advanced on the router AND on every member,
+#      the fleet still serves clean 2xx traffic, and the run emits
+#      FLYWHEEL_r02.json (schema mxr_flywheel_report) whose ADDITIVE
+#      fleet fields (generation_promoted — a perf-gate FLOOR —
+#      promotion_gate_pass, drift_detected, members) pass
+#      scripts/perf_gate.py --check-format next to an r01 report.
+#
+#   bash script/flywheel_fleet_smoke.sh
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${FLYWHEEL_FLEET_SMOKE_DIR:-/tmp/mxr_flywheel_fleet_smoke}
+rm -rf "$dir"
+mkdir -p "$dir"
+cap="$dir/capture"
+ckpt="$dir/ckpt"
+cache="$dir/program_cache"   # shared AOT warm-start: 3 boots, 1 compile
+telf="$dir/tel_fleet"
+mkdir -p "$ckpt"
+
+common=(--network resnet50 --synthetic --serve-batch 2 --max-delay-ms 20
+        --max-queue 32 --deadline-ms 120000 --program-cache "$cache"
+        --cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)"
+        --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32)
+
+# three free localhost ports: router, member 0, member 1
+read -r RP M0 M1 <<<"$(python - <<'EOF'
+import socket
+socks = [socket.socket() for _ in range(3)]
+for s in socks:
+    s.bind(("127.0.0.1", 0))
+print(" ".join(str(s.getsockname()[1]) for s in socks))
+for s in socks:
+    s.close()
+EOF
+)"
+
+wait_ready() {
+python - "$1" "$2" "$3" <<'EOF'
+import os, sys, time
+from mx_rcnn_tpu.serve import tcp_http_request
+port, pid, want = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("server exited before becoming ready")
+    try:
+        status, doc = tcp_http_request("127.0.0.1", port, "GET", "/readyz",
+                                       timeout=5)
+        if want <= 1 and status == 200:
+            sys.exit(0)
+        if want > 1 and doc.get("ready_members", 0) >= want:
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1)
+sys.exit("server never became ready")
+EOF
+}
+
+# ---- act 1: fabric up, shared capture dir, one injected fault ------------
+echo "flywheel_fleet_smoke: [1/4] router + 2 capturing members" \
+     "(m0 under dup-manifest injection)"
+python serve.py --network resnet50 --fabric --port "$RP" \
+  --probe-interval-s 1 --telemetry-dir "$telf" &
+rpid=$!
+MXR_REPLICA_INDEX=0 MXR_FAULT_FLYWHEEL_DUP_MANIFEST=m0 \
+  python serve.py "${common[@]}" --port "$M0" --join "127.0.0.1:$RP" \
+  --capture-dir "$cap" --capture-member m0 --capture-shard-records 8 &
+m0pid=$!
+MXR_REPLICA_INDEX=1 python serve.py "${common[@]}" --port "$M1" \
+  --join "127.0.0.1:$RP" \
+  --capture-dir "$cap" --capture-member m1 --capture-shard-records 8 &
+m1pid=$!
+trap 'kill "$rpid" "$m0pid" "$m1pid" 2>/dev/null || true' EXIT
+wait_ready "$RP" "$rpid" 2
+
+# ---- act 2: traffic until both members have spilled ----------------------
+echo "flywheel_fleet_smoke: [2/4] loadgen until both members spilled"
+python scripts/loadgen.py --port "$RP" --n 48 --rate 20 \
+  --short 80 --long 110 --assert-2xx | tee "$dir/traffic.json"
+
+python - "$RP" "$cap" "$dir" <<'EOF'
+import json, sys, time
+from mx_rcnn_tpu.flywheel import merge_manifests
+from mx_rcnn_tpu.serve import tcp_http_request
+port, cap, d = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+deadline = time.time() + 120
+while True:
+    merged = merge_manifests(cap)
+    per = {m["member"]: len(m["shards"]) for m in merged["members"].values()}
+    if per.get("m0", 0) >= 1 and per.get("m1", 0) >= 1:
+        break
+    if time.time() > deadline:
+        sys.exit(f"both members never spilled: {per}")
+    time.sleep(1)
+# the injected duplicate delivery is on disk and folds to ONE entry
+assert merged["duplicates_dropped"] >= 1, merged
+status, m = tcp_http_request("127.0.0.1", port, "GET", "/metrics",
+                             timeout=10)
+assert status == 200, m
+fw = m.get("flywheel") or {}
+captured = sum(e.get("flywheel", {}).get("captured", 0)
+               for e in m.get("engines", {}).values()) or fw.get("captured", 0)
+snap = {"captured": captured,
+        "generation_before": m["fabric"]["generation"],
+        "duplicates_dropped": merged["duplicates_dropped"]}
+json.dump(snap, open(f"{d}/snap.json", "w"))
+print(f"flywheel_fleet_smoke: capture OK (shards per member {per}, "
+      f"{captured} captured, dup manifests dropped "
+      f"{merged['duplicates_dropped']})")
+EOF
+
+# ---- act 3: distributed mine -> replay train -> gated promotion ----------
+echo "flywheel_fleet_smoke: [3/4] fleet round: merge/fold -> train -> gate"
+python flywheel.py fleet --capture-dir "$cap" --top-k 16 \
+  --min-label-score 0.0 --eval-every 4 --quality-slack 1.0 \
+  --ckpt-prefix "$ckpt" --promote-to "127.0.0.1:$RP" --rounds 2 \
+  --telemetry-dir "$dir/tel_fleet_driver" -- \
+  python train_end2end.py --network resnet50 --synthetic \
+  --synthetic_images 16 \
+  --cfg "tpu__SCALES=((64,96),)" --cfg "tpu__MAX_GT=4" \
+  --cfg "network__ANCHOR_SCALES=(2,4)" \
+  --cfg "TRAIN__RPN_PRE_NMS_TOP_N=200" \
+  --cfg "TRAIN__RPN_POST_NMS_TOP_N=32" \
+  --cfg "TRAIN__BATCH_ROIS=16" \
+  --prefix "$ckpt" --end_epoch 1 --num-steps 6 --frequent 2 \
+  --replay-ratio 0.5 --replay-thresh 0.0 \
+  | tee "$dir/fleet.json"
+
+# ---- act 4: the promoted generation is live on EVERY member --------------
+echo "flywheel_fleet_smoke: [4/4] certify fleet-wide promotion"
+python - "$RP" "$dir" <<'EOF'
+import json, sys, time
+from mx_rcnn_tpu.serve import tcp_http_request
+port, d = int(sys.argv[1]), sys.argv[2]
+snap = json.load(open(f"{d}/snap.json"))
+fleet = json.loads(open(f"{d}/fleet.json").read().strip().splitlines()[-1])
+assert fleet["promoted"] >= 1, f"fleet loop never promoted: {fleet}"
+assert fleet["mined"] > 0 and fleet["eval"] is not None, fleet
+assert sorted(fleet["members"]) == ["m0", "m1"], fleet
+assert fleet["duplicates_dropped"] >= 1, fleet
+deadline = time.time() + 120
+while True:
+    status, m = tcp_http_request("127.0.0.1", port, "GET", "/metrics",
+                                 timeout=10)
+    assert status == 200, m
+    fab = m["fabric"]
+    gens = [r["generation"] for r in fab["members"].values()]
+    if (fab["generation"] > snap["generation_before"] and len(gens) == 2
+            and all(g == fab["generation"] for g in gens)
+            and fab["ready"] == 2):
+        break
+    if time.time() > deadline:
+        sys.exit(f"promoted generation never rolled fleet-wide: {fab}")
+    time.sleep(1)
+c = fab["counters"]
+assert c["reload_rollback"] == 0, c
+assert c["quality_rejected"] == 0, c
+snap["generation_after"] = fab["generation"]
+snap["mined"] = fleet["mined"]
+snap["scanned"] = fleet["scanned"]
+snap["promoted"] = fleet["promoted"]
+snap["drift"] = fleet.get("drift") or {}
+json.dump(snap, open(f"{d}/snap.json", "w"))
+print(f"flywheel_fleet_smoke: promotion OK (generation "
+      f"{snap['generation_before']} -> {snap['generation_after']} on "
+      f"every member, reloads={c['reload']})")
+EOF
+
+# the freshly-promoted fleet still serves clean
+python scripts/loadgen.py --port "$RP" --n 6 --rate 10 \
+  --short 80 --long 110 --assert-2xx >/dev/null
+kill -TERM "$m0pid" "$m1pid" "$rpid"
+wait "$rpid" || true
+wait "$m0pid" "$m1pid" || true
+trap - EXIT
+
+# ---- report + perf gate --------------------------------------------------
+python - "$dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+snap = json.load(open(f"{d}/snap.json"))
+doc = {
+    "schema": "mxr_flywheel_report", "version": 1,
+    "captured": snap["captured"],
+    "mined": snap["mined"],
+    "scanned": snap["scanned"],
+    "generation_before": snap["generation_before"],
+    "generation_after": snap["generation_after"],
+    # fleet-mode ADDITIVE fields (FLYWHEEL_r02+): generation_promoted
+    # is the chaos-certification floor scripts/perf_gate.py gates on
+    "members": 2,
+    "generation_promoted": snap["promoted"],
+    "promotion_gate_pass": snap["promoted"],
+    "drift_detected": 1 if snap["drift"].get("drifted") else 0,
+    "duplicates_dropped": snap["duplicates_dropped"],
+}
+with open(f"{d}/FLYWHEEL_r02.json", "w") as f:
+    json.dump(doc, f, indent=1, sort_keys=True)
+print(f"flywheel_fleet_smoke: report OK (mined {doc['mined']}/"
+      f"{doc['captured']} captured across {doc['members']} members, "
+      f"{doc['generation_promoted']} generation(s) promoted)")
+EOF
+python scripts/perf_gate.py --check-format "$dir"/FLYWHEEL_r*.json
+python scripts/perf_gate.py --dir "$dir"
+
+# the fleet driver's telemetry stream renders the flywheel table with
+# the fleet counters
+python scripts/telemetry_report.py "$dir/tel_fleet_driver" \
+  | tee "$dir/report.txt"
+grep -E '^flywheel/promoted +[1-9]' "$dir/report.txt"
+echo "flywheel_fleet_smoke: OK"

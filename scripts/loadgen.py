@@ -342,9 +342,36 @@ def fabric_member_requests(host, port, timeout=10.0):
             if isinstance(m, dict)}
 
 
+def fold_flywheel_sections(doc):
+    """Fold a ``/metrics`` doc's flywheel stats into one
+    ``{"captured", "sample_every"}`` view.  A single engine carries a
+    top-level ``flywheel`` section; a fabric router instead folds member
+    metrics under ``engines``, so fleet capture sums ``captured`` across
+    members (``sample_every`` is the max — the most conservative
+    expected-capture divisor).  ``{}`` when nothing captures."""
+    fw = doc.get("flywheel")
+    if isinstance(fw, dict):
+        return {"captured": int(fw.get("captured", 0)),
+                "sample_every": max(int(fw.get("sample_every", 1)), 1)}
+    captured, sample_every, found = 0, 1, False
+    engines = doc.get("engines")
+    if isinstance(engines, dict):
+        for m in engines.values():
+            sub = m.get("flywheel") if isinstance(m, dict) else None
+            if isinstance(sub, dict):
+                found = True
+                captured += int(sub.get("captured", 0))
+                sample_every = max(sample_every,
+                                   int(sub.get("sample_every", 1)))
+    if not found:
+        return {}
+    return {"captured": captured, "sample_every": sample_every}
+
+
 def flywheel_capture_stats(args, timeout=10.0):
     """``{"captured": n, "sample_every": k}`` from the target server's
-    ``/metrics`` flywheel section (TCP or Unix socket); ``{}`` when the
+    ``/metrics`` flywheel section (TCP or Unix socket) — folded across
+    fabric members when the target is a router; ``{}`` when the
     endpoint is unreachable or capture is not enabled there."""
     try:
         if args.unix_socket:
@@ -363,11 +390,7 @@ def flywheel_capture_stats(args, timeout=10.0):
         return {}
     if status != 200 or not isinstance(doc, dict):
         return {}
-    fw = doc.get("flywheel")
-    if not isinstance(fw, dict):
-        return {}
-    return {"captured": int(fw.get("captured", 0)),
-            "sample_every": max(int(fw.get("sample_every", 1)), 1)}
+    return fold_flywheel_sections(doc)
 
 
 def trace_stats(args, timeout=10.0):

@@ -87,6 +87,10 @@ HOST_PREP_ABS_SLACK_MS = 2.0
 # hot-reloaded at least one replay-trained checkpoint generation
 FLYWHEEL_MINED_FRACTION_FLOOR = 0.01
 FLYWHEEL_GENERATION_FLOOR = 1.0
+# fleet mode (FLYWHEEL_r02+): under injected chaos the loop must still
+# promote at least one generation — a silently-stalled flywheel fails
+# the gate instead of shipping
+FLYWHEEL_PROMOTED_FLOOR = 1.0
 # streaming (mxr_stream_report + the serve-bench stream fields):
 # dispatches_per_frame is a counter ratio, not wall-clock, but batch
 # fill still varies with thread scheduling — allow a quarter-dispatch
@@ -225,6 +229,25 @@ def flywheel_report_rows(doc: dict) -> list:
                      "unit": "generations",
                      "floor": doc.get("generation_floor",
                                       FLYWHEEL_GENERATION_FLOOR)})
+    # fleet-mode fields (FLYWHEEL_r02+) are strictly additive: absent in
+    # an r01 report, so its rows — and the r01 gate verdict — are
+    # untouched.  generation_promoted is the chaos-certification FLOOR;
+    # the gate/drift tallies ride along ungated for trend visibility.
+    promoted = doc.get("generation_promoted")
+    if isinstance(promoted, (int, float)):
+        rows.append({"metric": "flywheel_generation_promoted",
+                     "value": float(promoted),
+                     "unit": "generations",
+                     "floor": doc.get("promoted_floor",
+                                      FLYWHEEL_PROMOTED_FLOOR)})
+    for field, metric in (("promotion_gate_pass",
+                           "flywheel_promotion_gate_pass"),
+                          ("drift_detected",
+                           "flywheel_drift_detected")):
+        val = doc.get(field)
+        if isinstance(val, (int, float)):
+            rows.append({"metric": metric, "value": float(val),
+                         "unit": "count"})
     return rows
 
 

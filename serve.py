@@ -200,6 +200,11 @@ def parse_args():
     parser.add_argument("--capture-shard-records", type=int, default=32,
                         dest="capture_shard_records",
                         help="records per spilled shard pair")
+    parser.add_argument("--capture-member", default=None,
+                        dest="capture_member",
+                        help="fleet member id folded into shard/manifest "
+                             "names when several members share one "
+                             "capture dir (default: hostname)")
     # -- multi-model serving (ISSUE 15) — all opt-in; without --models
     # the single-model boot path is byte-for-byte unchanged
     parser.add_argument("--models", default="",
@@ -378,7 +383,8 @@ def _build_engine(args, cfg, external: bool = False):
             capture_dir=args.capture_dir,
             sample_every=args.capture_sample,
             shard_records=args.capture_shard_records,
-            byte_budget=args.capture_bytes))
+            byte_budget=args.capture_bytes,
+            member=getattr(args, "capture_member", None)))
     engine.start(external=external)
     return predictor, engine
 

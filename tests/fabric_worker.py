@@ -34,6 +34,15 @@ def main(argv=None):
     ap.add_argument("--delay-s", type=float, default=0.0, dest="delay_s")
     ap.add_argument("--join", default="")
     ap.add_argument("--advertise", default="")
+    # fleet-flywheel capture (ISSUE 17): members share one capture dir,
+    # distinguished by --capture-member in shard/manifest names
+    ap.add_argument("--capture-dir", default="", dest="capture_dir")
+    ap.add_argument("--capture-member", default=None,
+                    dest="capture_member")
+    ap.add_argument("--capture-sample", type=int, default=1,
+                    dest="capture_sample")
+    ap.add_argument("--capture-shard-records", type=int, default=4,
+                    dest="capture_shard_records")
     args = ap.parse_args(argv)
 
     cfg = tiny_cfg()
@@ -43,7 +52,15 @@ def main(argv=None):
     pred = FakeServePredictor(cfg, params, delay_s=args.delay_s)
     engine = ServeEngine(pred, cfg, ServeOptions(
         batch_size=args.serve_batch, max_delay_ms=1.0,
-        max_queue=32)).start()
+        max_queue=32))
+    if args.capture_dir:
+        from mx_rcnn_tpu.flywheel import CaptureOptions, RequestCapture
+        engine.capture = RequestCapture(CaptureOptions(
+            capture_dir=args.capture_dir,
+            sample_every=args.capture_sample,
+            shard_records=args.capture_shard_records,
+            member=args.capture_member))
+    engine.start()
     serve_replica(engine, cfg, port=args.port, index=args.replica_index,
                   predictor=pred, load_params_fn=load_params,
                   join=args.join or None,

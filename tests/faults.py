@@ -38,7 +38,14 @@ Flywheel capture chaos (ISSUE 13), same split again:
 ``RequestCapture`` in ``mx_rcnn_tpu/flywheel/capture.py``;
 :func:`flywheel_fault_env` is the composer for tests/test_flywheel.py
 and script/flywheel_smoke.sh.  The damaged shard's replay records then
-exercise the loader's PR-2 bad-record substitution path."""
+exercise the loader's PR-2 bad-record substitution path.
+
+Fleet-flywheel chaos (ISSUE 17), same split: the fleet fault env vars
+are parsed by package code (``MXR_FAULT_FLYWHEEL_DUP_MANIFEST`` in
+``flywheel/capture.py``; ``MXR_FAULT_FLYWHEEL_{PARTITION_MINE,
+KILL_TRAIN}`` in ``flywheel/fleet.py``); :func:`fleet_fault_env` is
+the composer for tests/test_flywheel_fleet.py and
+script/flywheel_fleet_smoke.sh."""
 
 from __future__ import annotations
 
@@ -200,6 +207,36 @@ def flywheel_fault_env(corrupt_shard=None, truncate_spill=None) -> dict:
         env[ENV_CORRUPT_SHARD] = str(int(corrupt_shard))
     if truncate_spill is not None:
         env[ENV_TRUNCATE_SPILL] = str(int(truncate_spill))
+    return env
+
+
+def fleet_fault_env(partition_mine=None, dup_manifest=None,
+                    kill_train=None) -> dict:
+    """Compose the fleet-flywheel ``MXR_FAULT_FLYWHEEL_*`` env dict:
+
+    * ``partition_mine="m1"`` (str or list of member ids) — those
+      members are unreachable during the distributed mine; the fold
+      proceeds without their rankings.
+    * ``dup_manifest="m0"`` (member id, or ``"*"`` for every member) —
+      each manifest write is delivered TWICE under distinct filenames
+      (the at-least-once delivery shape the merge must fold to one
+      member entry, highest seq winning).
+    * ``kill_train=(round, seconds)`` — the trainer subprocess of the
+      chosen round is SIGKILLed that many seconds in (mid-epoch)."""
+    from mx_rcnn_tpu.flywheel.capture import ENV_DUP_MANIFEST
+    from mx_rcnn_tpu.flywheel.fleet import (ENV_KILL_TRAIN,
+                                            ENV_PARTITION_MINE)
+
+    env = {}
+    if partition_mine is not None:
+        if isinstance(partition_mine, str):
+            partition_mine = [partition_mine]
+        env[ENV_PARTITION_MINE] = ",".join(partition_mine)
+    if dup_manifest is not None:
+        env[ENV_DUP_MANIFEST] = str(dup_manifest)
+    if kill_train is not None:
+        rnd, secs = kill_train
+        env[ENV_KILL_TRAIN] = f"{int(rnd)}:{float(secs)}"
     return env
 
 

@@ -456,21 +456,29 @@ def test_respawned_replica_catches_up_to_plane_generation():
 # -- replica-side: checkpoint discovery + watcher ---------------------------
 
 
+def _committed_ckpt(path):
+    """Fabricate a COMMITTED checkpoint dir: int-named with real
+    payload, the post-atomic-rename shape scan_checkpoints selects."""
+    path.mkdir()
+    (path / "params.npz").write_bytes(b"x")
+    return path
+
+
 def test_scan_checkpoints_prefers_furthest_position(tmp_path):
     assert rp.scan_checkpoints(str(tmp_path / "missing")) is None
     assert rp.scan_checkpoints(str(tmp_path)) is None   # empty prefix
-    (tmp_path / "1").mkdir()
-    (tmp_path / "2").mkdir()
+    _committed_ckpt(tmp_path / "1")
+    _committed_ckpt(tmp_path / "2")
     # in-progress orbax tmp dirs never int-parse → invisible
     (tmp_path / "3.orbax-checkpoint-tmp-99").mkdir()
     t = rp.scan_checkpoints(str(tmp_path))
     assert (t["kind"], t["epoch"], t["consumed"]) == ("epoch", 2, 0)
     steps = tmp_path / "steps"
     steps.mkdir()
-    (steps / str(2 * 10 ** 7 + 5)).mkdir()  # epoch 2, consumed 5
+    _committed_ckpt(steps / str(2 * 10 ** 7 + 5))  # epoch 2, consumed 5
     t = rp.scan_checkpoints(str(tmp_path))
     assert (t["kind"], t["epoch"], t["consumed"]) == ("step", 2, 5)
-    (tmp_path / "3").mkdir()                # a finished epoch 3 beats it
+    _committed_ckpt(tmp_path / "3")         # a finished epoch 3 beats it
     t = rp.scan_checkpoints(str(tmp_path))
     assert (t["kind"], t["epoch"], t["consumed"]) == ("epoch", 3, 0)
 
