@@ -38,6 +38,13 @@ jitted bucket programs, and the shared ``ops/postprocess`` block that
   freshness-checked queue-depth gauges, per-member circuit breakers,
   request hedging, partition-tolerant degraded serving, and rolling
   hot-reload across remote members.
+* ``autoscaler`` — the capacity authority over the fabric: forecasts
+  demand from the pool's queue-depth gauges (PR-6 least-squares slope),
+  scales the fleet between configured bounds through existing surfaces
+  only (supervisor on-demand spawn/retire, member park/unpark via the
+  register path, model-pool residency rebalance), with hysteresis,
+  per-direction cooldowns, a thrash-freeze guard, and a zero-recompile
+  assertion over registry counters on every scale event.
 
 Driver: top-level ``serve.py`` (``--replicas N`` for the plane);
 load generator: ``scripts/loadgen.py``; throughput: ``bench.py --mode
@@ -45,6 +52,10 @@ serve``; smoke: ``script/serve_smoke.sh``, ``script/slo_smoke.sh``, and
 ``script/replica_smoke.sh``.
 """
 
+from mx_rcnn_tpu.serve.autoscaler import (AutoscalerOptions,
+                                          CapacityAuthority,
+                                          fleet_compile_counters,
+                                          fleet_compiled_programs)
 from mx_rcnn_tpu.serve.controller import ControllerOptions, SLOController
 from mx_rcnn_tpu.serve.engine import (DeadlineExceededError, RejectedError,
                                       ServeEngine, ServeFuture, ServeOptions)
@@ -87,4 +98,6 @@ __all__ = ["ServeEngine", "ServeOptions", "ServeFuture", "RejectedError",
            "tcp_http_request", "tcp_http_request_raw",
            "StreamManager", "StreamOptions", "StaleSeqError",
            "FrameResult", "run_stream_stdio",
-           "ModelPool", "ModelEntry", "param_nbytes"]
+           "ModelPool", "ModelEntry", "param_nbytes",
+           "AutoscalerOptions", "CapacityAuthority",
+           "fleet_compile_counters", "fleet_compiled_programs"]

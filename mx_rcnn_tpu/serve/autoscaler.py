@@ -1,0 +1,592 @@
+"""Elastic autoscaling: the capacity authority for the serving fabric
+(ISSUE 18).
+
+The PR-12 fabric supervises whatever fleet the operator started —
+membership, probes, breakers, least-loaded routing — but nothing ever
+decides *how many* members there should be.  :class:`CapacityAuthority`
+closes that loop.  It is a control loop in the PR-6 mold: one injectable
+``tick(now=None)`` step that tests drive with a fake clock and
+production wraps in a daemon monitor thread.
+
+Signals (all pre-existing — the authority adds none of its own probes):
+
+- fabric per-member ``queue_depth``/``inflight`` gauges, folded by
+  :meth:`ReplicaPool.demand` under the same stale-gauge contract as
+  least-loaded routing;
+- the PR-6 SLO controller's exported :meth:`capacity_signal` (queue
+  depth, least-squares slope, drain rate, shed state) for co-resident
+  engines;
+- the PR-15 model pool's scheduler depth, via
+  :meth:`ModelPool.rebalance_residency`.
+
+Demand is *forecast*, not just measured: the authority keeps a trailing
+``(t, demand)`` window and extends it ``forecast_s`` seconds ahead with
+the PR-6 least-squares ``_slope`` — a rising queue scales the fleet up
+before the queue is deep, which is the only way a scale-up that takes
+seconds can beat a flash crowd that takes milliseconds.
+
+Actuation goes through existing surfaces only:
+
+- local fork replicas: :meth:`ReplicaSupervisor.add_replica` /
+  :meth:`retire_replica` (the PR-8 on-demand spawn API), adopted into
+  the pool with :meth:`ReplicaPool.adopt_handle`;
+- remote members: re-admission via the same ``register`` path as
+  ``/admin/register`` (parked members first, then the standby list),
+  and graceful scale-down via :meth:`ReplicaPool.park_member` — the
+  unroute → drain-in-flight sequence from the PR-8 reload, minus the
+  swap;
+- model placement: :meth:`ModelPool.rebalance_residency` pages the
+  hottest models resident at runtime (placement is a runtime decision,
+  never a boot decision).
+
+Hard invariant — scaling NEVER causes a recompile.  New capacity warms
+from the shared AOT program cache and params stay runtime args, so the
+registry's ``aot_miss`` counter must not move across a scale event.
+Every scale-up snapshots the per-member registry counters
+(:func:`fleet_compile_counters`, including the member about to become
+routable — its boot history must not be mistaken for a fresh compile)
+and re-checks each member against its own baseline once the new
+capacity is ready; growth is an ``autoscale/recompile_violation``
+counter plus a flight dump, not a silent regression.
+
+A noisy signal must not flap the fleet: scale-up and scale-down have
+separate cooldowns, scale-down additionally requires
+``down_after_ticks`` consecutive low-load ticks below a hysteresis band
+(``down_headroom``×target), and a thrash guard freezes the authority
+(with a flight dump) when the scale direction flips too often inside
+``thrash_window_s``.
+
+Every decision is first-class telemetry: ``autoscale/*`` counters and
+gauges, an ``autoscale_decision`` meta event per action — carrying a
+PR-16 trace id when tracing is on — and ``state()`` for the fabric
+``/metrics`` pane.  With ``--autoscale`` off the authority is never
+constructed and the fleet behaves byte-for-byte as before (pinned by
+test).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.serve.controller import _slope
+from mx_rcnn_tpu.serve.frontend import address_request
+from mx_rcnn_tpu.telemetry import tracectx
+
+
+@dataclass(frozen=True)
+class AutoscalerOptions:
+    min_members: int = 1        # never drain below this fleet size
+    max_members: int = 4        # never grow past this fleet size
+    target_depth: float = 4.0   # demand (queue+inflight) per ready member
+    interval_s: float = 1.0     # monitor tick period
+    trend_ticks: int = 8        # demand history length for the slope
+    forecast_s: float = 3.0     # look-ahead horizon (predictive scale-up)
+    up_cooldown_s: float = 5.0        # min spacing between scale-ups
+    down_cooldown_s: float = 20.0     # min spacing between scale-downs
+    down_headroom: float = 0.5  # hysteresis band: down only below h×target
+    down_after_ticks: int = 3   # consecutive low ticks before a down
+    thrash_window_s: float = 60.0     # flip-counting window
+    thrash_flips: int = 4       # direction flips in window → freeze
+    freeze_s: float = 30.0      # how long a thrash freeze lasts
+    verify_timeout_s: float = 60.0    # zero-recompile check deadline
+
+    def __post_init__(self):
+        if self.min_members < 0:
+            raise ValueError("min_members must be >= 0")
+        if self.max_members < max(self.min_members, 1):
+            raise ValueError("max_members must be >= max(min_members, 1)")
+        if self.target_depth <= 0:
+            raise ValueError("target_depth must be > 0")
+        if not 0.0 < self.down_headroom < 1.0:
+            raise ValueError("down_headroom must be in (0, 1) — at 1.0 "
+                             "the up and down thresholds touch and any "
+                             "noise flaps the fleet")
+        if self.down_after_ticks < 1:
+            raise ValueError("down_after_ticks must be >= 1")
+
+
+def _registry_misses(doc) -> Optional[int]:
+    """Registry ``aot_miss`` out of one member's ``/metrics`` doc (an
+    actual XLA compile — ``aot_hit`` is a cache load and costs nothing).
+    ``None`` when the member has no registry (shape-fake tests): no
+    registry, nothing to assert."""
+    if not isinstance(doc, dict):
+        return None
+    compile_doc = doc.get("compile")
+    if not isinstance(compile_doc, dict):
+        return None
+    counters = compile_doc.get("counters") or {}
+    return int(counters.get("aot_miss", 0) or 0)
+
+
+def fleet_compile_counters(pool, extra=()) -> Dict[str, int]:
+    """Best-effort **per-member** compiled-program counters over the
+    routable fleet, plus any ``extra`` addresses that are about to
+    become routable (a parked member being unparked, a standby being
+    admitted).  Per-member is load-bearing: a member's counter carries
+    its own boot history, so a scale event that makes an old member
+    routable again would shift a fleet-wide *sum* even though nothing
+    compiled — each member must be diffed against itself."""
+    out: Dict[str, int] = {}
+    for m in pool.routable_members():
+        try:
+            status, doc = m.http("GET", "/metrics", timeout=5.0)
+        except Exception:  # noqa: BLE001 — member mid-death; skip
+            continue
+        if status != 200:
+            continue
+        misses = _registry_misses(doc)
+        if misses is not None:
+            out[m.name] = misses
+    for addr in extra:
+        if not addr or addr in out:
+            continue
+        try:
+            status, doc = address_request(addr, "GET", "/metrics",
+                                          timeout=5.0)
+        except Exception:  # noqa: BLE001 — not up yet; no history then
+            continue
+        if status != 200:
+            continue
+        misses = _registry_misses(doc)
+        if misses is not None:
+            out[addr] = misses
+    return out
+
+
+def fleet_compiled_programs(pool) -> int:
+    """Fleet-wide compiled-program count: the sum over
+    :func:`fleet_compile_counters`.  The scalar view for reports and
+    tests; the authority's own verify diffs the per-member map."""
+    return sum(fleet_compile_counters(pool).values())
+
+
+class CapacityAuthority:
+    """The capacity control loop over one fabric pool.
+
+    ``tick(now=None)`` is one decision step and returns the list of
+    decision docs it acted on (empty on a hold) so tests can assert the
+    loop without threads.  ``start()`` wraps it in the standard daemon
+    monitor; ``stop()`` joins it.
+
+    ``supervisor`` (optional) grants local fork spawn/retire authority;
+    ``model_pool`` (optional) grants residency rebalance; ``controllers``
+    (optional) are co-resident :class:`SLOController` instances whose
+    :meth:`capacity_signal` feeds demand and shed pressure; ``standby``
+    is a list of remote addresses the authority may admit when demand
+    outgrows the registered fleet.  ``compile_probe`` overrides
+    :func:`fleet_compiled_programs` for deterministic tests."""
+
+    def __init__(self, pool, supervisor=None, model_pool=None,
+                 controllers=(), opts: Optional[AutoscalerOptions] = None,
+                 standby=(), compile_probe: Optional[Callable] = None):
+        self.pool = pool
+        self.sup = supervisor
+        self.model_pool = model_pool
+        self.controllers = list(controllers)
+        self.opts = opts or AutoscalerOptions()
+        self.standby = [str(a) for a in standby]
+        # None → the per-member default; injected probes may return a
+        # scalar (tests) or a per-member dict — verify handles both
+        self._compile_probe = compile_probe
+        self._lock = threading.Lock()
+        self._demand_hist: List[tuple] = []  # (t, demand) trend window
+        self._low_streak = 0          # consecutive below-band ticks
+        self._blocked_warned = False  # one warning per blocked episode
+        self._last_up_t: Optional[float] = None
+        self._last_down_t: Optional[float] = None
+        self._last_direction = 0      # +1 up / -1 down (thrash input)
+        self._flips: List[float] = []  # direction-change instants
+        self._frozen_until = 0.0
+        self._pending_verify: List[dict] = []  # open recompile checks
+        self.ticks = 0
+        self.last_demand = 0.0
+        self.last_forecast = 0.0
+        self.last_slope = 0.0
+        self.counters = {"scale_up": 0, "scale_down": 0, "hold": 0,
+                         "spawn": 0, "retire": 0, "unpark": 0, "park": 0,
+                         "admit_standby": 0, "blocked": 0,
+                         "thrash_freeze": 0, "recompile_violation": 0,
+                         "recompile_check": 0, "rebalance": 0}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def count(self, key: str, inc: int = 1):
+        """Authority counter + the matching ``autoscale/*`` telemetry
+        counter — one source for ``state()`` and the report table."""
+        self.counters[key] = self.counters.get(key, 0) + inc
+        telemetry.get().counter(f"autoscale/{key}", inc)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "CapacityAuthority":
+        assert self._thread is None, "autoscaler already started"
+
+        def monitor():
+            while not self._stop.wait(self.opts.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — capacity must survive
+                    logger.exception("autoscaler tick failed")
+
+        self._thread = threading.Thread(target=monitor,
+                                        name="capacity-authority",
+                                        daemon=True)
+        self._thread.start()
+        logger.info("autoscaler: capacity authority up (fleet %d..%d, "
+                    "target depth/member %.1f, forecast %.1fs)",
+                    self.opts.min_members, self.opts.max_members,
+                    self.opts.target_depth, self.opts.forecast_s)
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    # -- signals ---------------------------------------------------------
+
+    def _gather(self, now: float) -> dict:
+        """One consolidated signal sample: fabric demand + co-resident
+        SLO controller depth, with shed state as immediate pressure."""
+        demand = float(self.pool.demand(now))
+        shedding = False
+        for c in self.controllers:
+            try:
+                sig = c.capacity_signal()
+            except Exception:  # noqa: BLE001 — a dying engine is not news
+                continue
+            demand += max(float(sig.get("queue_depth", 0) or 0), 0.0)
+            shedding = shedding or bool(sig.get("shedding"))
+        with self._lock:
+            self._demand_hist.append((now, demand))
+            if len(self._demand_hist) > self.opts.trend_ticks:
+                self._demand_hist = \
+                    self._demand_hist[-self.opts.trend_ticks:]
+            slope = _slope(self._demand_hist)
+        forecast = max(demand + slope * self.opts.forecast_s, 0.0)
+        return {"demand": demand, "slope": slope, "forecast": forecast,
+                "shedding": shedding}
+
+    # -- the decision step -----------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """One capacity decision.  Gather → forecast → (maybe) act →
+        verify open zero-recompile checks → emit telemetry."""
+        now = time.monotonic() if now is None else now
+        self.ticks += 1
+        o = self.opts
+        sig = self._gather(now)
+        fleet = self.pool.capacity_count()
+        ready = self.pool.ready_count()
+        per_member = sig["forecast"] / max(ready, 1)
+        self.last_demand = sig["demand"]
+        self.last_forecast = sig["forecast"]
+        self.last_slope = sig["slope"]
+
+        decisions: List[dict] = []
+        frozen = now < self._frozen_until
+        if not frozen:
+            if fleet < o.min_members:
+                decisions += self._scale_up(now, sig, fleet, ready,
+                                            reason="below_min")
+            elif (per_member > o.target_depth or sig["shedding"]) \
+                    and fleet < o.max_members \
+                    and self._cooled(self._last_up_t, o.up_cooldown_s,
+                                     now):
+                reason = "shed_pressure" if sig["shedding"] \
+                    else "forecast_over_target"
+                decisions += self._scale_up(now, sig, fleet, ready,
+                                            reason=reason)
+            elif per_member < o.down_headroom * o.target_depth \
+                    and sig["slope"] <= 0 and fleet > o.min_members \
+                    and ready > 0:
+                self._low_streak += 1
+                if self._low_streak >= o.down_after_ticks \
+                        and self._cooled(self._last_down_t,
+                                         o.down_cooldown_s, now):
+                    decisions += self._scale_down(now, sig, fleet, ready)
+            else:
+                self._low_streak = 0
+        if not decisions:
+            self.count("hold")
+        if not any(d["action"] == "blocked" for d in decisions):
+            self._blocked_warned = False   # episode over; warn again next time
+
+        self._verify_pending(now)
+        if self.model_pool is not None:
+            self._rebalance(now)
+
+        tel = telemetry.get()
+        tel.gauge("autoscale/demand", sig["demand"])
+        tel.gauge("autoscale/forecast", sig["forecast"])
+        tel.gauge("autoscale/slope", sig["slope"])
+        tel.gauge("autoscale/fleet", fleet)
+        tel.gauge("autoscale/ready", ready)
+        tel.gauge("autoscale/per_member", round(per_member, 3))
+        tel.gauge("autoscale/frozen", int(frozen))
+        return decisions
+
+    @staticmethod
+    def _cooled(last_t: Optional[float], cooldown_s: float,
+                now: float) -> bool:
+        return last_t is None or now - last_t >= cooldown_s
+
+    # -- actuation -------------------------------------------------------
+
+    def _scale_up(self, now: float, sig: dict, fleet: int, ready: int,
+                  reason: str) -> List[dict]:
+        """Add one member, cheapest capacity first: unpark a drained
+        remote (warm process, zero boot cost), then admit a standby
+        address, then fork a local replica via the supervisor."""
+        how, detail = None, None
+        parked = self.pool.parked_members()
+        standby = self._unregistered_standby()
+        if parked:
+            how, detail = "unpark", parked[0]
+        elif standby:
+            how, detail = "admit_standby", standby[0]
+        elif self.sup is not None:
+            how = "spawn"
+        if how is not None:
+            # baseline BEFORE actuation, and per-member: an unparked or
+            # admitted member brings its own boot-time compile history
+            # into the routable set — snapshot it now so only compiles
+            # caused by THIS event can show up in the verify diff (a
+            # spawned child has no pre-history; its boot misses count)
+            baseline = self._probe_compiles(
+                extra=(detail,) if detail else ())
+        if how == "unpark":
+            self.pool.register(detail, now=now)
+            self.count("unpark")
+        elif how == "admit_standby":
+            self.pool.register(detail, now=now)
+            self.count("admit_standby")
+        elif how == "spawn":
+            h = self.sup.add_replica(now=now)
+            m = self.pool.adopt_handle(h)
+            self.count("spawn")
+            detail = m.name
+        else:
+            self.count("blocked")
+            if not self._blocked_warned:
+                # a fleet waiting on members to boot would otherwise
+                # re-warn every tick; the counter keeps the full tally
+                self._blocked_warned = True
+                logger.warning("autoscaler: scale-up wanted (%s) but no "
+                               "capacity source — no parked member, empty "
+                               "standby list, no supervisor", reason)
+            return [self._decide(now, "blocked", reason, sig, fleet,
+                                 ready, member=None)]
+        self._last_up_t = now
+        self._note_direction(now, +1)
+        self.count("scale_up")
+        self._low_streak = 0
+        if baseline is not None:
+            self.count("recompile_check")
+            self._pending_verify.append(
+                {"deadline": now + self.opts.verify_timeout_s,
+                 "baseline": baseline, "want_ready": ready + 1,
+                 "member": detail})
+        logger.info("autoscaler: scale UP via %s (%s) — %s; demand %.1f "
+                    "forecast %.1f slope %.2f fleet %d→%d", how, detail,
+                    reason, sig["demand"], sig["forecast"], sig["slope"],
+                    fleet, fleet + 1)
+        return [self._decide(now, f"scale_up:{how}", reason, sig, fleet,
+                             ready, member=detail)]
+
+    def _scale_down(self, now: float, sig: dict, fleet: int,
+                    ready: int) -> List[dict]:
+        """Drain one member gracefully: pick the least-loaded routable
+        member (remote preferred — parking is reversible for free),
+        unroute it, wait out its in-flight requests, then park (remote)
+        or retire (local fork)."""
+        victim = self._pick_victim(now)
+        if victim is None:
+            return []
+        if victim.kind == "remote":
+            ok = self.pool.park_member(victim.name)
+            how = "park"
+            if ok:
+                self.count("park")
+        else:
+            ok = self.sup is not None \
+                and self.sup.retire_replica(victim.handle)
+            how = "retire"
+            if ok:
+                self.pool.release_local(victim.name)
+                self.count("retire")
+        if not ok:
+            # drain raced a readmit or the handle vanished — not an
+            # error, just not a scale-down; try again next tick
+            self._low_streak = 0
+            return []
+        self._last_down_t = now
+        self._note_direction(now, -1)
+        self.count("scale_down")
+        self._low_streak = 0
+        logger.info("autoscaler: scale DOWN via %s (%s) — demand %.1f "
+                    "forecast %.1f fleet %d→%d", how, victim.name,
+                    sig["demand"], sig["forecast"], fleet, fleet - 1)
+        return [self._decide(now, f"scale_down:{how}", "below_band", sig,
+                             fleet, ready, member=victim.name)]
+
+    def _pick_victim(self, now: float):
+        """Least-loaded routable member; ties prefer remote (a parked
+        remote costs nothing to bring back) and then the latest joiner."""
+        stale_after = self.pool.opts.stale_after_s
+        best, best_key = None, None
+        for m in self.pool.routable_members():
+            depth = 0.0
+            if m.depth is not None and m.depth_t is not None \
+                    and now - m.depth_t <= stale_after:
+                depth = float(m.depth)
+            key = (depth + float(m.inflight),
+                   0 if m.kind == "remote" else 1, m.name)
+            if best_key is None or key < best_key:
+                best, best_key = m, key
+        return best
+
+    def _unregistered_standby(self) -> List[str]:
+        with self.pool._lock:
+            known = set(self.pool.members)
+        return [a for a in self.standby if a not in known]
+
+    # -- zero-recompile verification -------------------------------------
+
+    def _probe_compiles(self, extra=()):
+        """Snapshot compile counters: the per-member map by default
+        (``extra`` = addresses this scale event is about to make
+        routable, so their boot history lands in the baseline), or
+        whatever an injected probe returns (scalar or map)."""
+        try:
+            if self._compile_probe is None:
+                return fleet_compile_counters(self.pool, extra=extra)
+            v = self._compile_probe()
+        except Exception:  # noqa: BLE001 — probe is best-effort
+            return None
+        if v is None or isinstance(v, dict):
+            return v
+        return int(v)
+
+    def _verify_pending(self, now: float):
+        """Close out open scale events: once the fleet reaches the
+        expected ready count (or the deadline passes), re-probe the
+        registry counters — growth means new capacity COMPILED instead
+        of warming from the shared AOT cache, which breaks the contract
+        that params are runtime args and placement is free."""
+        if not self._pending_verify:
+            return
+        still_open = []
+        for check in self._pending_verify:
+            ripe = self.pool.ready_count() >= check["want_ready"] \
+                or now >= check["deadline"]
+            if not ripe:
+                still_open.append(check)
+                continue
+            probe = self._probe_compiles()
+            base = check["baseline"]
+            if probe is None:
+                delta = 0
+            elif isinstance(probe, dict) and isinstance(base, dict):
+                # each member against ITS OWN baseline — a member newly
+                # routable since the snapshot (absent key) is capacity
+                # this event added, so all its misses are event-caused
+                delta = sum(max(v - base.get(k, 0), 0)
+                            for k, v in probe.items())
+            else:
+                delta = max(int(probe) - int(base), 0)
+            telemetry.get().gauge("autoscale/recompiles_during_scale",
+                                  delta)
+            if delta > 0:
+                self.count("recompile_violation", delta)
+                telemetry.get().dump_flight(
+                    "autoscale_recompile", member=check["member"],
+                    compiled=delta, baseline=check["baseline"])
+                logger.error("autoscaler: ZERO-RECOMPILE VIOLATION — "
+                             "%d program(s) compiled while %s warmed "
+                             "(capacity must come from the shared AOT "
+                             "cache)", delta, check["member"])
+        self._pending_verify = still_open
+
+    # -- residency rebalance ---------------------------------------------
+
+    def _rebalance(self, now: float):
+        try:
+            paged = self.model_pool.rebalance_residency()
+        except Exception:  # noqa: BLE001 — paging races model eviction
+            return
+        if paged:
+            self.count("rebalance", len(paged))
+            telemetry.get().meta("autoscale_rebalance", models=paged)
+
+    # -- thrash guard ----------------------------------------------------
+
+    def _note_direction(self, now: float, direction: int):
+        """A scale action in the opposite direction from the last one is
+        a flip; too many flips inside the window means the signal is
+        oscillating faster than capacity can follow — freeze and dump."""
+        if self._last_direction and direction != self._last_direction:
+            self._flips.append(now)
+        self._last_direction = direction
+        self._flips = [t for t in self._flips
+                       if now - t <= self.opts.thrash_window_s]
+        if len(self._flips) >= self.opts.thrash_flips:
+            self._frozen_until = now + self.opts.freeze_s
+            self._flips = []
+            self.count("thrash_freeze")
+            telemetry.get().dump_flight(
+                "autoscale_thrash", flips=self.opts.thrash_flips,
+                window_s=self.opts.thrash_window_s,
+                freeze_s=self.opts.freeze_s)
+            logger.error("autoscaler: THRASH — %d direction flips in "
+                         "%.0fs; frozen for %.0fs (a fleet that flaps "
+                         "serves worse than a fleet one member too "
+                         "small)", self.opts.thrash_flips,
+                         self.opts.thrash_window_s, self.opts.freeze_s)
+
+    # -- telemetry -------------------------------------------------------
+
+    def _decide(self, now: float, action: str, reason: str, sig: dict,
+                fleet: int, ready: int, member) -> dict:
+        doc = {"action": action, "reason": reason, "member": member,
+               "demand": round(sig["demand"], 3),
+               "forecast": round(sig["forecast"], 3),
+               "slope": round(sig["slope"], 4),
+               "fleet": fleet, "ready": ready}
+        tracer = tracectx.get()
+        if tracer.enabled:
+            # decisions are first-class: each gets its own trace id so
+            # the PR-16 tooling can correlate the decision with the
+            # member churn it caused
+            ctx = tracer.mint()
+            doc["trace"] = ctx.trace_id
+            with tracer.span(ctx, "autoscale_decision", action=action,
+                             reason=reason, member=str(member)):
+                pass
+        telemetry.get().meta("autoscale_decision", **doc)
+        return doc
+
+    def state(self) -> dict:
+        """JSON-able authority state for the fabric ``/metrics`` pane."""
+        with self._lock:
+            hist = list(self._demand_hist)
+        return {"options": {
+                    "min_members": self.opts.min_members,
+                    "max_members": self.opts.max_members,
+                    "target_depth": self.opts.target_depth,
+                    "forecast_s": self.opts.forecast_s},
+                "ticks": self.ticks,
+                "demand": round(self.last_demand, 3),
+                "forecast": round(self.last_forecast, 3),
+                "slope": round(self.last_slope, 4),
+                "low_streak": self._low_streak,
+                "frozen": time.monotonic() < self._frozen_until,
+                "pending_verify": len(self._pending_verify),
+                "counters": dict(self.counters)}

@@ -117,6 +117,7 @@ class SLOController:
         self.last_p99_ms: Optional[float] = None
         self.last_drain_rate = 0.0
         self.last_slope = 0.0
+        self.last_depth = 0
 
     # -- lifecycle -------------------------------------------------------
 
@@ -258,6 +259,7 @@ class SLOController:
             self.last_p99_ms = p99_ms
             self.last_drain_rate = rate
             self.last_slope = slope
+            self.last_depth = depth
             if acted:
                 self.decisions += len(acted)
 
@@ -316,6 +318,27 @@ class SLOController:
                     "slo/drain_rate": self.last_drain_rate,
                 },
             }
+
+    def capacity_signal(self) -> dict:
+        """The ISSUE-18 signal export: everything the capacity authority
+        needs from this controller in one locked read — measured queue
+        depth, the least-squares slope, the drain forecast, and whether
+        shedding is active (shedding means demand already outran THIS
+        engine's capacity: immediate scale-up pressure, no forecasting
+        required)."""
+        with self._lock:
+            depth = self.last_depth
+            rate = self.last_drain_rate
+            drain_s = (depth / rate) if rate > 0 else \
+                (float("inf") if depth > 0 else 0.0)
+            return {"label": self.opts.label,
+                    "target_p99_ms": self.opts.target_p99_ms,
+                    "p99_ms": self.last_p99_ms,
+                    "queue_depth": depth,
+                    "slope": self.last_slope,
+                    "drain_rate": rate,
+                    "drain_s": drain_s,
+                    "shedding": self._shedding}
 
 
 def _slope(points) -> float:

@@ -90,6 +90,7 @@ JOINING = "joining"          # registered; first successful probe pending
 MEMBER_READY = "ready"       # /readyz 200 — routable unless mid-reload
 EVICTED = "evicted"          # unreachable; re-probed on backoff
 QUARANTINED = "quarantined"  # systemic: probing stopped until re-register
+PARKED = "parked"            # autoscaler drained it; spare warm capacity
 
 
 @dataclass(frozen=True)
@@ -213,12 +214,17 @@ class RemoteMember:
         self.ready_t = 0.0
         self.next_probe_t = 0.0   # eviction backoff schedule
         self.last_reload = None   # last /admin/reload response doc
+        self.scale_drain = False     # autoscale park drain in progress
+        self.readmit_pending = False  # register() raced that drain
         self.inflight_lock = threading.Lock()  # hedge + handler threads
         self.breaker = CircuitBreaker(opts.breaker_failures,
                                       opts.breaker_cooldown_s)
 
     def is_active(self) -> bool:
-        return self.state != QUARANTINED
+        # parked capacity is deliberately out of service: it must not
+        # count toward the partition denominator any more than a
+        # quarantined member does
+        return self.state not in (QUARANTINED, PARKED)
 
     def is_ready(self) -> bool:
         return self.state == MEMBER_READY
@@ -361,7 +367,8 @@ class ReplicaPool:
                          "hedge_won": 0, "retry": 0, "retry_ok": 0,
                          "retry_budget_exhausted": 0, "no_ready": 0,
                          "transport_error": 0, "requests": 0,
-                         "quality_rejected": 0}
+                         "quality_rejected": 0, "member_parked": 0,
+                         "member_unparked": 0}
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -390,14 +397,33 @@ class ReplicaPool:
                 self.members[m.name] = m
                 logger.info("fabric: member %s registered", m.name)
             elif getattr(m, "kind", "remote") == "remote" \
-                    and m.state in (EVICTED, QUARANTINED):
+                    and getattr(m, "scale_drain", False):
+                # the readmit raced an autoscale park drain of this very
+                # address: do NOT flip any routing state mid-drain (a
+                # half-routable member is worse than either outcome) —
+                # park_member() honors the flag when the drain settles
+                m.readmit_pending = True
+                unparked = False
+                logger.info("fabric: member %s re-registered mid-drain — "
+                            "readmit deferred until the drain settles",
+                            m.name)
+            elif getattr(m, "kind", "remote") == "remote" \
+                    and m.state in (EVICTED, QUARANTINED, PARKED):
+                was = m.state
                 m.state = JOINING
                 m.failures = 0
                 m.probe_fails = 0
                 m.next_probe_t = 0.0
                 m.joined_t = now
+                unparked = was == PARKED
                 logger.info("fabric: member %s re-registered (was %s)",
-                            m.name, EVICTED)
+                            m.name, was)
+            else:
+                unparked = False
+        if created:
+            unparked = False
+        if unparked:
+            self.count("member_unparked")
         self._wake.set()
         return m, created
 
@@ -421,6 +447,33 @@ class ReplicaPool:
             for h in sup.handles:
                 m = LocalMember(h, sup, self.opts)
                 self.members[m.name] = m
+
+    def adopt_handle(self, h) -> LocalMember:
+        """Adopt ONE supervisor handle added after boot
+        (:meth:`ReplicaSupervisor.add_replica` — the autoscaler's
+        on-demand spawn): :meth:`adopt_supervisor` wraps only the
+        boot-time slots, so runtime capacity needs its own door."""
+        if self.sup is None and h is not None:
+            raise RuntimeError("adopt_handle needs adopt_supervisor "
+                               "first — the pool routes, the supervisor "
+                               "owns the process")
+        with self._lock:
+            m = LocalMember(h, self.sup, self.opts)
+            if m.name in self.members:
+                return self.members[m.name]
+            self.members[m.name] = m
+        self._wake.set()
+        return m
+
+    def release_local(self, name: str) -> bool:
+        """Forget a retired fork child's LocalMember (the supervisor
+        already drained and reaped the process)."""
+        with self._lock:
+            m = self.members.get(name)
+            if m is None or m.kind != "local":
+                return False
+            del self.members[name]
+        return True
 
     # -- default probing/reload wiring -----------------------------------
 
@@ -493,7 +546,10 @@ class ReplicaPool:
                 m.depth_t = now
 
     def _poll_remote(self, m: RemoteMember, now: float):
-        if m.state == QUARANTINED:
+        if m.state in (QUARANTINED, PARKED):
+            # parked = deliberately idle warm capacity; probing it would
+            # flip it READY and defeat the scale-down — /admin/register
+            # (the autoscaler's unpark) is the only way back in
             return
         if m.state == EVICTED and now < m.next_probe_t:
             return
@@ -670,6 +726,97 @@ class ReplicaPool:
     def ready_count(self) -> int:
         return len(self.routable_members())
 
+    # -- scale-decision hooks (ISSUE 18) ---------------------------------
+
+    def park_member(self, name: str) -> bool:
+        """Graceful autoscale scale-down of one remote member: the PR-8
+        unroute → wait-in-flight sequence verbatim, minus the swap —
+        then PARKED (spare warm capacity, not probed, re-admitted only
+        by ``/admin/register``).  A concurrent register of the same
+        address sets ``readmit_pending`` instead of touching routing
+        state; it is honored HERE, under the lock, once the drain
+        settles — the member ends either fully parked or fully back in
+        rotation, never half-routable."""
+        with self._lock:
+            m = self.members.get(name)
+            if m is None:
+                try:
+                    m = self.members.get(normalize_address(name))
+                except ValueError:
+                    m = None
+            if m is None or m.kind != "remote" or not m.is_ready():
+                return False
+            m.scale_drain = True
+            m.routable = False
+            m.reloading = True  # probes must not re-route mid-drain
+        try:
+            self._wait_inflight_drained(m)
+        finally:
+            with self._lock:
+                m.reloading = False
+                m.scale_drain = False
+                if m.readmit_pending:
+                    m.readmit_pending = False
+                    parked = False
+                    if m.is_ready():
+                        m.routable = True
+                else:
+                    m.state = PARKED
+                    m.routable = False
+                    m.depth_t = None  # its gauge is history, not data
+                    parked = True
+        if parked:
+            self.count("member_parked")
+            logger.info("fabric: member %s parked (autoscale drain "
+                        "complete; warm spare)", m.name)
+        else:
+            logger.info("fabric: member %s park ABANDONED — a register "
+                        "raced the drain and the readmit wins", m.name)
+        return parked
+
+    def parked_members(self) -> List[str]:
+        """Addresses of parked (warm spare) members — the autoscaler's
+        cheapest scale-up source."""
+        with self._lock:
+            return [m.address for m in self.members.values()
+                    if m.kind == "remote" and m.state == PARKED]
+
+    def member_state_counts(self) -> Dict[str, int]:
+        """``{state: n}`` over every member, local and remote — the
+        fleet-size view behind the Prometheus ``fabric_member_count``
+        gauges and the autoscaler's clamps."""
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for m in self.members.values():
+                counts[m.state] = counts.get(m.state, 0) + 1
+        return counts
+
+    def capacity_count(self) -> int:
+        """Members holding (or warming toward) serving capacity — the
+        autoscaler's fleet size.  Parked / quarantined / evicted /
+        failed / stopped slots are spare or dead, not capacity."""
+        spare = (PARKED, QUARANTINED, EVICTED, FAILED, STOPPED)
+        with self._lock:
+            return sum(1 for m in self.members.values()
+                       if m.state not in spare)
+
+    def demand(self, now: Optional[float] = None) -> float:
+        """Aggregate demand over routable members: fresh queue-depth
+        samples plus router in-flight, under the SAME stale-gauge
+        contract as least-loaded routing (a stale sample counts zero —
+        better to under-forecast than to scale on history)."""
+        now = time.monotonic() if now is None else now
+        total = 0.0
+        with self._lock:
+            for m in self.members.values():
+                if not (m.routable and not m.reloading):
+                    continue
+                if m.depth is not None and m.depth_t is not None \
+                        and now - m.depth_t <= self.opts.stale_after_s:
+                    total += float(m.depth)
+                total += float(m.inflight)
+        return total
+
     # -- rolling hot reload ----------------------------------------------
 
     def _wait_inflight_drained(self, m) -> bool:
@@ -837,6 +984,7 @@ class FabricRouter:
             self._fwd_headers = False
         self._rr = 0
         self._rr_lock = threading.Lock()
+        self.autoscaler = None  # CapacityAuthority, when --autoscale
         self.retry_bucket = TokenBucket(pool.opts.retry_budget,
                                         pool.opts.retry_refill_per_s)
 
@@ -1062,6 +1210,8 @@ class FabricRouter:
         out["engines"] = per
         out["aggregate_counters"] = agg
         out["generation"] = self.pool.generation
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.state()
         tracer = tracectx.get()
         if tracer.enabled:
             out["trace"] = tracer.metrics()
@@ -1094,6 +1244,12 @@ def fabric_prometheus(router: FabricRouter) -> str:
                     _point_gauge(m.depth)
                 gauges[f"fabric/queue_depth_age_s/{m.name}"] = \
                     _point_gauge(round(now - m.depth_t, 3))
+    if router.autoscaler is not None:
+        a = router.autoscaler.state()
+        for key in ("demand", "forecast", "slope"):
+            gauges[f"autoscale/{key}"] = _point_gauge(a[key])
+        for key, v in a["counters"].items():
+            counters[f"autoscale/{key}"] = v
     tracer = tracectx.get()
     if tracer.enabled:
         for key, v in tracer.metrics().items():
@@ -1102,8 +1258,24 @@ def fabric_prometheus(router: FabricRouter) -> str:
             elif isinstance(v, (int, float)):
                 gauges[f"trace/{key}"] = _point_gauge(v)
     rank = telemetry.get().rank
-    return prometheus_text({rank: {"counters": counters,
+    text = prometheus_text({rank: {"counters": counters,
                                    "gauges": gauges}})
+    # aggregate fleet-size-by-state gauges (ISSUE 18): a real labeled
+    # family, appended raw because the shared renderer only labels by
+    # rank/stat — smoke scripts assert fleet size with one grep instead
+    # of parsing the JSON membership view.  Every known state is always
+    # emitted (zeros included) so an assertion on an absent state reads
+    # 0, not a missing series; "ready" covers both member kinds (the
+    # remote MEMBER_READY and local READY strings are one state).
+    counts = pool.member_state_counts()
+    known = (JOINING, MEMBER_READY, EVICTED, QUARANTINED, PARKED,
+             "starting", "backoff", FAILED, STOPPED)
+    lines = ["# HELP fabric_member_count members by state (local and "
+             "remote)", "# TYPE fabric_member_count gauge"]
+    for state in list(known) + sorted(set(counts) - set(known)):
+        lines.append(f'fabric_member_count{{state="{state}"}} '
+                     f'{counts.get(state, 0)}')
+    return text + "\n".join(lines) + "\n"
 
 
 class _FabricHandler(_Handler):

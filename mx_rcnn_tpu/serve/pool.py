@@ -344,6 +344,49 @@ class ModelPool:
                                        for d in models.values()),
                 "models": models}
 
+    def demand_scores(self) -> dict:
+        """``{model_id: weight * (queue_depth + 1)}`` — the scheduler's
+        own scoring, exported as the autoscaler's placement signal
+        (ISSUE 18)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        scores = {}
+        for e in entries:
+            try:
+                depth = e.engine.queue_depth()
+            except Exception:  # noqa: BLE001 — engine mid-shutdown
+                depth = 0
+            scores[e.model_id] = e.weight * (depth + 1)
+        return scores
+
+    def rebalance_residency(self) -> List[str]:
+        """Runtime placement for the capacity authority: page the
+        hottest queued-but-not-resident models in ahead of their next
+        dispatch (``ensure_resident`` pages out cold LRU siblings to
+        make room).  Paging is a ``device_put`` of a host snapshot —
+        params are runtime args, so placement costs zero recompiles.
+        Returns the model ids paged in (empty in the steady state, and
+        always empty without a byte budget: everything is resident)."""
+        with self._lock:
+            cold = [(e.model_id, e.weight, e.engine)
+                    for e in self._entries.values() if not e.resident]
+        hot = []
+        for mid, weight, engine in cold:
+            try:
+                depth = engine.queue_depth()
+            except Exception:  # noqa: BLE001 — engine mid-shutdown
+                continue
+            if depth > 0:
+                hot.append((weight * (depth + 1), mid))
+        paged = []
+        for _, mid in sorted(hot, reverse=True):
+            try:
+                self.ensure_resident(mid)
+                paged.append(mid)
+            except KeyError:
+                continue  # removed mid-rebalance
+        return paged
+
     # -- cross-model dispatch --------------------------------------------
 
     def _pick_locked(self, now: float):

@@ -166,7 +166,12 @@ def build_child_argv(argv: List[str], sock: str, index: int) -> List[str]:
              # a plain unix-socket replica even under a fabric parent
              "--fabric": 0, "--join": 1, "--advertise": 1,
              "--pool-file": 1, "--hedge-after-ms": 1,
-             "--partition-floor": 1}
+             "--partition-floor": 1,
+             # the capacity authority is the PARENT's business too — a
+             # fork child must never run its own autoscaler
+             "--autoscale": 0, "--autoscale-min": 1,
+             "--autoscale-max": 1, "--autoscale-target-depth": 1,
+             "--autoscale-interval-s": 1, "--autoscale-standby": 1}
     out = [sys.executable, argv[0]]
     i = 1
     while i < len(argv):
@@ -225,7 +230,8 @@ class ReplicaSupervisor:
                          "hang_kill": 0, "reload": 0, "reload_rollback": 0,
                          "retry": 0, "retry_ok": 0,
                          "retry_budget_exhausted": 0, "no_ready": 0,
-                         "transport_error": 0}
+                         "transport_error": 0, "scale_spawn": 0,
+                         "scale_retire": 0}
         self.retry_bucket = TokenBucket(self.opts.retry_budget,
                                         self.opts.retry_refill_per_s)
         self._stop = threading.Event()
@@ -253,6 +259,94 @@ class ReplicaSupervisor:
         now = time.monotonic() if now is None else now
         for h in self.handles:
             self._spawn(h, now)
+
+    # -- on-demand capacity (ISSUE 18: the autoscaler's spawn API) -------
+
+    def _next_spec_locked(self) -> ReplicaSpec:
+        """Synthesize the next slot's spec from slot 0's: same argv with
+        the trailing ``--unix-socket SOCK --replica-index I`` pair
+        (appended last by :func:`build_child_argv`, so the positions are
+        a contract) rebound to a fresh index and socket."""
+        if not self.handles:
+            raise RuntimeError("add_replica on an empty supervisor "
+                               "needs an explicit spec — there is no "
+                               "slot to template from")
+        tmpl = self.handles[0].spec
+        idx = max(h.index for h in self.handles) + 1
+        sock = os.path.join(os.path.dirname(tmpl.sock),
+                            f"replica_{idx}.sock")
+        argv = list(tmpl.argv)
+        argv[-3] = sock
+        argv[-1] = str(idx)
+        env = dict(tmpl.env, MXR_REPLICA_INDEX=str(idx))
+        env.pop("MXR_REPLICA_DEVICES", None)  # device pin is per-slot
+        return ReplicaSpec(argv, sock, idx, env)
+
+    def add_replica(self, spec: Optional[ReplicaSpec] = None,
+                    now: Optional[float] = None) -> ReplicaHandle:
+        """Grow the plane by one slot at runtime and spawn it
+        immediately — the autoscaler's scale-up actuation.  The new
+        replica warms from the same shared AOT program cache as its
+        siblings, so bringing it up costs a cache load, not a compile.
+        Returns the new handle (callers under a fabric adopt it with
+        :meth:`ReplicaPool.adopt_handle`)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if spec is None:
+                spec = self._next_spec_locked()
+            h = ReplicaHandle(spec)
+            self.handles.append(h)
+        self.counters["scale_spawn"] += 1
+        telemetry.get().counter("replica/scale_spawn")
+        self._spawn(h, now)
+        self._wake.set()
+        return h
+
+    def retire_replica(self, h: ReplicaHandle,
+                       graceful_timeout: float = 5.0) -> bool:
+        """Shrink the plane by one slot — the autoscaler's scale-down
+        actuation: unroute → wait out the router's in-flight requests
+        (the PR-8 drain, minus the swap) → SIGTERM (the replica drains
+        its own engine queue on the way out) → reap → drop the slot.
+        Returns False for a handle this supervisor doesn't own."""
+        with self._lock:
+            if h not in self.handles:
+                return False
+            h.routable = False
+            h.reloading = True  # suspect-clear must not re-route it
+        try:
+            self._wait_inflight_drained(h)
+        finally:
+            with self._lock:
+                h.reloading = False
+                h.state = STOPPED
+                h.routable = False
+        proc = h.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=graceful_timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    proc.kill()
+                    proc.wait(timeout=5.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        try:
+            os.unlink(h.spec.sock)
+        except OSError:
+            pass
+        with self._lock:
+            if h in self.handles:
+                self.handles.remove(h)
+        self.counters["scale_retire"] += 1
+        telemetry.get().counter("replica/scale_retire")
+        logger.info("replica %d: retired (scale-down drain complete)",
+                    h.index)
+        return True
 
     def start(self) -> "ReplicaSupervisor":
         assert self._thread is None, "supervisor already started"
@@ -412,7 +506,11 @@ class ReplicaSupervisor:
         thread each ``probe_interval_s``; tests call it directly with a
         fake clock).  Probe I/O runs outside the lock."""
         now = time.monotonic() if now is None else now
-        for h in self.handles:
+        # snapshot: add_replica/retire_replica mutate the slot list from
+        # the autoscaler's thread while this loop is mid-iteration
+        with self._lock:
+            handles = list(self.handles)
+        for h in handles:
             with self._lock:
                 state = h.state
             if state in (FAILED, STOPPED):

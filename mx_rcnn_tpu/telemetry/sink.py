@@ -456,11 +456,18 @@ class Telemetry:
             return None
         os.makedirs(self.out_dir, exist_ok=True)
         path = os.path.join(self.out_dir, f"flight_{self.rank}.jsonl")
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            for ev in events:
-                f.write(json.dumps(ev) + "\n")
-        os.replace(tmp, path)
+        # tmp must be unique per CALL, not just per process: two threads
+        # dumping concurrently (e.g. a partition declared while the
+        # autoscaler freezes) would otherwise share one tmp and the
+        # second os.replace finds it already consumed
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "w") as f:
+                for ev in events:
+                    f.write(json.dumps(ev) + "\n")
+            os.replace(tmp, path)
+        except OSError:  # out_dir torn down mid-shutdown; ring has it
+            return None
         return path
 
     # -- reading ---------------------------------------------------------

@@ -1,0 +1,216 @@
+#!/usr/bin/env bash
+# Elastic-autoscaling smoke (CPU-friendly): the ISSUE-18 capacity
+# authority over a real localhost-TCP fabric with the real model and
+# synthetic weights — one router with --autoscale plus TWO standalone
+# TCP members that self-register with --join, sharing one AOT program
+# cache so only the first boot compiles.
+#
+#   1. Idle drain — with the fleet bounded 1..2 and nothing to serve,
+#      the authority parks one member back to the minimum.  The
+#      Prometheus exposition must show the parked member in the
+#      aggregate fabric_member_count{state=...} gauges (the satellite-1
+#      fleet-size assert: one grep, no JSON parsing).
+#   2. Flash crowd — scripts/loadgen.py --profile flashcrowd drives the
+#      time-varying open-loop schedule (1× base rate, an 8× spike, then
+#      1× again) while its FleetWatcher samples the router's
+#      ready-member count.  The spike must UNPARK the warm spare
+#      (member count tracks load), requests keep resolving, and the
+#      authority's zero-recompile verification must pass: new capacity
+#      warms from the shared AOT cache, params stay runtime args, so
+#      the engines' recompile counters must not move.
+#   3. Drain back — the crowd passes and the authority parks the spare
+#      again: up on trend, down on hysteresis, no flapping in between
+#      (thrash_freeze stays 0).
+#
+# The profile run lands as an mxr_autoscale_report (AUTOSCALE_r01.json)
+# scored by scripts/perf_gate.py: fleet growth against the scale-up
+# floor, time_to_scale_s against its ceiling, p99 through the scale
+# events against the pinned ceiling, and recompiles against a ZERO
+# ceiling — fleet_excess_recompiles folds the per-member registry
+# counters (compiles beyond warmup) into the same zero-ceiling row.
+#
+#   bash script/autoscale_smoke.sh
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${AUTOSCALE_SMOKE_DIR:-/tmp/mxr_autoscale_smoke}
+rm -rf "$dir"
+mkdir -p "$dir"
+cache="$dir/program_cache"   # shared AOT warm-start: 3 boots, 1 compile
+tel="$dir/tel"
+
+common=(--network resnet50 --synthetic --serve-batch 2 --max-delay-ms 20
+        --max-queue 32 --deadline-ms 120000 --program-cache "$cache"
+        --cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)"
+        --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32)
+
+# three free localhost ports: router, member 0, member 1
+read -r RP M0 M1 <<<"$(python - <<'EOF'
+import socket
+socks = [socket.socket() for _ in range(3)]
+for s in socks:
+    s.bind(("127.0.0.1", 0))
+print(" ".join(str(s.getsockname()[1]) for s in socks))
+for s in socks:
+    s.close()
+EOF
+)"
+
+# wait_fleet PORT PID WANT [OP]: poll the router's /readyz until the
+# ready-member count reaches (default) or drops to WANT — the autoscaler
+# moves the count in BOTH directions in this smoke
+wait_fleet() {
+python - "$1" "$2" "$3" "${4:-ge}" <<'EOF'
+import os, sys, time
+from mx_rcnn_tpu.serve import tcp_http_request
+port, pid, want, op = (int(sys.argv[1]), int(sys.argv[2]),
+                       int(sys.argv[3]), sys.argv[4])
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("router exited before the fleet settled")
+    try:
+        _, doc = tcp_http_request("127.0.0.1", port, "GET", "/readyz",
+                                  timeout=5)
+        n = doc.get("ready_members", 0)
+        if (op == "ge" and n >= want) or (op == "le" and n <= want):
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1)
+sys.exit(f"fleet never settled at {op} {want} ready members")
+EOF
+}
+
+# ---- fabric up: autoscaling router + 2 self-registering members ----------
+echo "autoscale_smoke: [1/3] idle fleet drains to --autoscale-min"
+python serve.py --network resnet50 --fabric --port "$RP" \
+  --probe-interval-s 0.5 --telemetry-dir "$tel" \
+  --autoscale --autoscale-min 1 --autoscale-max 2 \
+  --autoscale-target-depth 2 --autoscale-interval-s 0.5 &
+rpid=$!
+mpids=()
+for i in 0 1; do
+  mports=("$M0" "$M1")
+  MXR_REPLICA_INDEX=$i python serve.py "${common[@]}" \
+    --port "${mports[i]}" --join "127.0.0.1:$RP" &
+  mpids[i]=$!
+done
+trap 'kill "$rpid" "${mpids[@]}" 2>/dev/null || true' EXIT
+
+wait_fleet "$RP" "$rpid" 2            # both members join and warm up
+wait_fleet "$RP" "$rpid" 1 le         # ...then idle drains one to PARKED
+
+# satellite 1: the Prometheus exposition answers "how big is the fleet,
+# by state" with one labeled gauge family — assert it with a grep
+curl -sf "http://127.0.0.1:$RP/metrics?format=prom" >"$dir/prom.txt" \
+  || python - "$RP" "$dir/prom.txt" <<'EOF'
+import sys
+from mx_rcnn_tpu.serve import tcp_http_request_raw
+status, raw, _ = tcp_http_request_raw(
+    "127.0.0.1", int(sys.argv[1]), "GET", "/metrics?format=prom",
+    headers={"Accept": "text/plain"}, timeout=10)
+assert status == 200, status
+open(sys.argv[2], "wb").write(raw)
+EOF
+grep -q 'fabric_member_count{state="parked"} 1' "$dir/prom.txt"
+grep -q 'fabric_member_count{state="ready"} 1' "$dir/prom.txt"
+echo "autoscale_smoke: parked spare visible in fabric_member_count gauges"
+
+# ---- act 2: flash crowd → scale-up from the warm spare -------------------
+echo "autoscale_smoke: [2/3] flash crowd unparks the spare"
+python scripts/loadgen.py --port "$RP" --fabric --profile flashcrowd \
+  --n 40 --rate 2 --short 80 --long 110 --fleet-poll-s 0.3 \
+  --scale-floor 1 --time-to-scale-ceiling-s 90 --p99-ceiling-ms 60000 \
+  --report "$dir/AUTOSCALE_r01.json" | tee "$dir/flashcrowd.json"
+
+# the crowd scaled the fleet, nothing recompiled, nothing was dropped
+python - "$dir/AUTOSCALE_r01.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["schema"] == "mxr_autoscale_report", doc["schema"]
+row = doc["scenarios"][0]
+assert row["profile"] == "flashcrowd", row
+fleet = row["fleet"]
+assert fleet["peak"] > fleet["start"], \
+    f"the flash crowd never grew the fleet: {fleet}"
+assert row["time_to_scale_s"] is not None, fleet
+assert row["recompiles_during_run"] == 0, \
+    f"scale-up COMPILED {row['recompiles_during_run']} program(s)"
+sched = row["schedule"]
+assert len(sched) == 3 and sched[1]["rate"] == 8 * 2.0, sched
+print(f"autoscale_smoke: flash crowd OK (fleet {fleet['start']}→"
+      f"{fleet['peak']}, time_to_scale_s={row['time_to_scale_s']}, "
+      f"p99_ms={row['p99_ms']}, recompiles=0)")
+EOF
+
+# ---- act 3: crowd passes → drain back, authority stayed sane -------------
+echo "autoscale_smoke: [3/3] load drop drains the fleet back down"
+wait_fleet "$RP" "$rpid" 1 le
+
+# authority pane: both directions acted, zero violations, zero thrash;
+# per-member registry counters certify compiles == warmup only (the
+# fleet_excess_recompiles fed to the gate's zero-ceiling row)
+python - "$RP" "$M0" "$M1" "$dir/AUTOSCALE_r01.json" <<'EOF'
+import json, sys
+from mx_rcnn_tpu.serve import tcp_http_request
+rp = int(sys.argv[1])
+status, m = tcp_http_request("127.0.0.1", rp, "GET", "/metrics",
+                             timeout=10)
+assert status == 200, m
+a = m.get("autoscale")
+assert a, "router /metrics has no autoscale pane"
+c = a["counters"]
+assert c["scale_up"] >= 1 and c["unpark"] >= 1, c
+assert c["scale_down"] >= 1 and c["park"] >= 1, c
+assert c["recompile_violation"] == 0, c
+assert c["recompile_check"] >= 1, c
+assert c["thrash_freeze"] == 0, c
+excess = 0
+for port in (int(sys.argv[2]), int(sys.argv[3])):
+    try:
+        status, doc = tcp_http_request("127.0.0.1", port, "GET",
+                                       "/metrics", timeout=10)
+    except OSError:
+        continue                 # the parked member still answers, but
+    if status != 200:            # tolerate a mid-drain straggler
+        continue
+    counters = doc.get("counters") or {}
+    excess += max(int(counters.get("recompiles", 0))
+                  - int(counters.get("warmup_programs", 0)), 0)
+assert excess == 0, f"{excess} compile(s) beyond warmup across the fleet"
+doc = json.load(open(sys.argv[4]))
+doc["fleet_excess_recompiles"] = excess
+doc["recompile_ceiling"] = 0.0
+doc["autoscale_counters"] = c    # ride-along context for the archive
+json.dump(doc, open(sys.argv[4], "w"), indent=1, sort_keys=True)
+print(f"autoscale_smoke: authority OK (scale_up={c['scale_up']}, "
+      f"scale_down={c['scale_down']}, violations=0, excess_recompiles=0)")
+EOF
+
+kill -TERM "${mpids[@]}" "$rpid"
+wait "$rpid" || true
+wait "${mpids[@]}" || true
+trap - EXIT
+
+# every decision is first-class telemetry with the PR-16 trace plumbing
+python - "$tel" <<'EOF'
+import glob, json, sys
+events = []
+for path in glob.glob(f"{sys.argv[1]}/events_rank*.jsonl"):
+    for line in open(path):
+        events.append(json.loads(line))
+decisions = [e for e in events
+             if e.get("kind") == "meta" and e.get("name") == "autoscale_decision"]
+assert decisions, "no autoscale_decision meta events in the stream"
+acts = {d["fields"]["action"] for d in decisions}
+assert any(a.startswith("scale_up") for a in acts), acts
+assert any(a.startswith("scale_down") for a in acts), acts
+print(f"autoscale_smoke: telemetry OK ({len(decisions)} decision "
+      f"events, actions={sorted(acts)})")
+EOF
+
+# ---- perf gate -----------------------------------------------------------
+python scripts/perf_gate.py --check-format "$dir"/AUTOSCALE_r*.json
+python scripts/perf_gate.py --dir "$dir"
+echo "autoscale_smoke: OK"

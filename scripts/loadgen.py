@@ -138,8 +138,21 @@ from mx_rcnn_tpu.serve.frontend import (encode_image_payload,  # noqa: E402
 REPORT_SCHEMA = "mxr_slo_report"
 STREAM_REPORT_SCHEMA = "mxr_stream_report"
 MULTIMODEL_REPORT_SCHEMA = "mxr_multimodel_report"
+AUTOSCALE_REPORT_SCHEMA = "mxr_autoscale_report"
 REPORT_VERSION = 1
 SCENARIOS = ("steady", "bursty", "size-mix")
+PROFILES = ("diurnal", "flashcrowd")
+
+# time-varying open-loop profiles (ISSUE 18): per segment a fraction of
+# --n fired at a multiple of --rate.  diurnal = piecewise ramp up to a
+# peak and back (the daily traffic curve, compressed); flashcrowd = a
+# steady baseline with a near-back-to-back spike in the middle — the
+# shape a predictive autoscaler must beat
+PROFILE_SEGMENTS = {
+    "diurnal": ((0.2, 0.4), (0.2, 0.8), (0.2, 1.6), (0.2, 0.8),
+                (0.2, 0.4)),
+    "flashcrowd": ((0.4, 0.5), (0.4, 8.0), (0.2, 0.5)),
+}
 MOTIONS = ("static", "pan", "scene-cut")
 
 
@@ -162,6 +175,27 @@ def parse_args(argv=None):
                     help="bursty scenario: requests per burst (fired "
                          "back-to-back; bursts spaced to keep --rate on "
                          "average)")
+    ap.add_argument("--profile", default="", choices=("",) + PROFILES,
+                    help="time-varying open-loop rate schedule (ISSUE "
+                         "18): diurnal = piecewise ramp up/down around "
+                         "--rate, flashcrowd = baseline + spike; the "
+                         "segment schedule is emitted into the report "
+                         "row for reproducibility, and with --report "
+                         "the doc becomes an mxr_autoscale_report")
+    ap.add_argument("--fleet-poll-s", type=float, default=0.3,
+                    dest="fleet_poll_s",
+                    help="--profile + --fabric: sample the router's "
+                         "ready-member count this often during the run "
+                         "(feeds time_to_scale_s)")
+    ap.add_argument("--scale-floor", type=float, default=0.0,
+                    dest="scale_floor",
+                    help="autoscale report: perf_gate floor on peak "
+                         "minus starting ready-member count (0 = no "
+                         "row)")
+    ap.add_argument("--time-to-scale-ceiling-s", type=float, default=0.0,
+                    dest="time_to_scale_ceiling_s",
+                    help="autoscale report: perf_gate ceiling on "
+                         "time_to_scale_s (0 = trend-only row)")
     ap.add_argument("--report", default="",
                     help="write the machine-readable SLO report JSON here "
                          "(scenario mode)")
@@ -311,6 +345,115 @@ def schedule(scenario, n, rate, burst=8):
         burst = max(int(burst), 1)
         return [(i // burst) * (burst / rate) for i in range(n)]
     return [i / rate for i in range(n)]  # steady / size-mix
+
+
+def profile_schedule(profile, n, rate):
+    """Fire offsets for a time-varying profile (``PROFILE_SEGMENTS``),
+    plus the serialized segment schedule ``[{requests, rate, t0_s}, …]``
+    that goes into the report row — the run is reproducible from the doc
+    alone.  Unlike :func:`schedule`, profiles deliberately VARY the
+    rate: the shape is the test."""
+    fracs = PROFILE_SEGMENTS[profile]
+    offsets, segments = [], []
+    t = 0.0
+    remaining = n
+    for i, (frac, mult) in enumerate(fracs):
+        k = remaining if i == len(fracs) - 1 \
+            else min(int(round(n * frac)), remaining)
+        seg_rate = rate * mult if rate > 0 else 0.0
+        segments.append({"requests": k, "rate": round(seg_rate, 3),
+                         "t0_s": round(t, 3)})
+        for j in range(k):
+            offsets.append(t + (j / seg_rate if seg_rate > 0 else 0.0))
+        if k and seg_rate > 0:
+            t = offsets[-1] + 1.0 / seg_rate
+        remaining -= k
+        if remaining <= 0:
+            break
+    return offsets, segments
+
+
+class FleetWatcher:
+    """Samples a fabric router's ready-member count through ``/readyz``
+    while a profile run is in flight — the member-count-vs-time series
+    behind ``time_to_scale_s`` (how long the autoscaler took to grow the
+    fleet after the load arrived) and the scale-up/drain-back story in
+    the autoscale report."""
+
+    def __init__(self, host, port, poll_s=0.3):
+        self.host, self.port = host, port
+        self.poll_s = max(float(poll_s), 0.05)
+        self.samples = []  # (t_rel_s, ready_members)
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _sample(self):
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=5.0)
+        try:
+            conn.request("GET", "/readyz")
+            doc = json.loads(conn.getresponse().read())
+            return int(doc.get("ready_members", 0))
+        except (OSError, ValueError):
+            return None
+        finally:
+            conn.close()
+
+    def start(self):
+        t0 = time.monotonic()
+
+        def run():
+            while not self._stop.is_set():
+                v = self._sample()
+                if v is not None:
+                    self.samples.append(
+                        (round(time.monotonic() - t0, 3), v))
+                self._stop.wait(self.poll_s)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="fleet-watcher")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def report(self):
+        """``{start, peak, end, time_to_scale_s, samples}`` — ``None``
+        time_to_scale_s means the fleet never grew past its starting
+        size (a flat run, or the authority held)."""
+        s = list(self.samples)
+        if not s:
+            return {}
+        start = s[0][1]
+        tts = next((t for t, v in s if v > start), None)
+        return {"start": start, "peak": max(v for _, v in s),
+                "end": s[-1][1],
+                "time_to_scale_s": tts,
+                "samples": s}
+
+
+def fabric_engine_recompiles(host, port, timeout=10.0):
+    """``member → engine 'recompiles' counter`` from a fabric router's
+    ``/metrics`` engines fold — diffed around a profile run (common
+    members only) for the report's zero-recompile-during-scale assert."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", "/metrics")
+        doc = json.loads(conn.getresponse().read())
+    except (OSError, ValueError):
+        return {}
+    finally:
+        conn.close()
+    engines = doc.get("engines", {})
+    out = {}
+    for name, e in engines.items():
+        if isinstance(e, dict):
+            out[name] = int((e.get("counters") or {})
+                            .get("recompiles", 0) or 0)
+    return out
 
 
 def tcp_request(host, port, doc, timeout):
@@ -932,12 +1075,26 @@ def main(argv=None):
     for idx, scenario in enumerate(scenarios):
         docs = make_payloads(args, seed=args.seed + idx,
                              size_mix=(scenario == "size-mix"))
-        offsets = schedule(scenario or "steady", args.n, args.rate,
-                           burst=args.burst)
+        segments = None
+        if args.profile:
+            offsets, segments = profile_schedule(args.profile, args.n,
+                                                 args.rate)
+        else:
+            offsets = schedule(scenario or "steady", args.n, args.rate,
+                               burst=args.burst)
         before = (fabric_member_requests(args.host, args.port,
                                          timeout=args.timeout)
                   if args.fabric else None)
+        recompiles_before = (fabric_engine_recompiles(
+            args.host, args.port, timeout=args.timeout)
+            if args.fabric and args.profile else None)
+        watcher = None
+        if args.fabric and args.profile:
+            watcher = FleetWatcher(args.host, args.port,
+                                   poll_s=args.fleet_poll_s).start()
         results, wall = run_requests(args, docs, offsets)
+        if watcher is not None:
+            watcher.stop()
         all_results.extend(results)
         out = summarize(results, wall)
         if args.fabric:
@@ -945,6 +1102,29 @@ def main(argv=None):
                                            timeout=args.timeout)
             out["member_share"] = member_share(before, after)
             out["fabric_members"] = len(after)
+        if args.profile:
+            out["profile"] = args.profile
+            out["schedule"] = segments
+            if watcher is not None:
+                fleet = watcher.report()
+                out["fleet"] = fleet
+                out["time_to_scale_s"] = fleet.get("time_to_scale_s")
+            if recompiles_before is not None:
+                recompiles_after = fabric_engine_recompiles(
+                    args.host, args.port, timeout=args.timeout)
+                out["recompiles_during_run"] = sum(
+                    recompiles_after[k] - recompiles_before[k]
+                    for k in recompiles_after
+                    if k in recompiles_before)
+            # perf-gate pins for autoscale_report_rows()
+            if args.p99_ceiling_ms > 0:
+                out["p99_ceiling_ms"] = args.p99_ceiling_ms
+            if args.scale_floor > 0:
+                out["scale_floor"] = args.scale_floor
+            if args.time_to_scale_ceiling_s > 0:
+                out["time_to_scale_ceiling_s"] = \
+                    args.time_to_scale_ceiling_s
+            out["recompile_ceiling"] = 0.0
         if args.trace_sample > 0:
             out["traced"] = sum(1 for d in docs if "trace" in d)
             out["tail_kept"] = trace_stats(
@@ -957,11 +1137,16 @@ def main(argv=None):
                 if k in ("requests", "status", "p50_ms", "p99_ms",
                          "error_rate", "availability", "time_to_recover_s",
                          "imgs_per_sec", "wall_s", "member_share",
-                         "fabric_members", "traced", "tail_kept")}})
+                         "fabric_members", "traced", "tail_kept",
+                         "profile", "schedule", "fleet", "time_to_scale_s",
+                         "recompiles_during_run", "p99_ceiling_ms",
+                         "scale_floor", "time_to_scale_ceiling_s",
+                         "recompile_ceiling")}})
         print(json.dumps(out))
 
     if args.report:
-        doc = {"schema": REPORT_SCHEMA, "version": REPORT_VERSION,
+        schema = AUTOSCALE_REPORT_SCHEMA if args.profile else REPORT_SCHEMA
+        doc = {"schema": schema, "version": REPORT_VERSION,
                "scenarios": report_rows}
         with open(args.report, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)

@@ -101,6 +101,10 @@ FLYWHEEL_PROMOTED_FLOOR = 1.0
 # their own series and conservative first thresholds.
 STREAM_DPF_ABS_SLACK = 0.25
 BENCH_SKIP_FRACTION_FLOOR = 0.5
+# time_to_scale is dominated by the autoscaler's tick interval and the
+# member readiness probe cadence, both sub-second in the smoke — a
+# second of absolute noise before the relative trend threshold applies.
+TIME_TO_SCALE_ABS_SLACK = 1.0
 
 
 def slo_report_rows(doc: dict) -> list:
@@ -348,6 +352,71 @@ def multimodel_report_rows(doc: dict) -> list:
     return rows
 
 
+def autoscale_report_rows(doc: dict) -> list:
+    """Expand an ``mxr_autoscale_report`` (scripts/loadgen.py --profile,
+    script/autoscale_smoke.sh) into rows.  The ISSUE-18 properties score
+    the newest run alone: p99 through the scale events against the
+    CEILING the run pinned (``--p99-ceiling-ms`` — scaling must not blow
+    the SLO while it happens), fleet growth (peak − start) against the
+    ``scale_floor`` FLOOR (the authority must actually have scaled up
+    under the flash crowd), ``time_to_scale_s`` against its pinned
+    ceiling (a direction=down trend row otherwise), and
+    ``recompiles_during_run`` against a zero CEILING — elastic capacity
+    must come from the shared AOT cache, never from fresh XLA compiles.
+    A top-level ``fleet_excess_recompiles`` (injected by the smoke from
+    per-member registry counters: aot_miss beyond warmup) gets the same
+    zero-ceiling treatment."""
+    rows = []
+    for sc in doc.get("scenarios", []):
+        name = sc.get("name", "?")
+        p99 = sc.get("p99_ms")
+        if isinstance(p99, (int, float)):
+            row = {"metric": f"autoscale_{name}_p99_ms", "value": p99,
+                   "unit": "ms", "direction": "down"}
+            ceil = sc.get("p99_ceiling_ms")
+            if isinstance(ceil, (int, float)) and ceil > 0:
+                row = {"metric": f"autoscale_{name}_p99_ms", "value": p99,
+                       "unit": "ms", "ceiling": ceil}
+            rows.append(row)
+        er = sc.get("error_rate")
+        if isinstance(er, (int, float)):
+            rows.append({"metric": f"autoscale_{name}_error_rate",
+                         "value": er, "unit": "fraction",
+                         "direction": "down",
+                         "abs_slack": ERROR_RATE_ABS_SLACK})
+        fleet = sc.get("fleet") or {}
+        floor = sc.get("scale_floor")
+        if (isinstance(floor, (int, float)) and floor > 0
+                and isinstance(fleet.get("peak"), (int, float))
+                and isinstance(fleet.get("start"), (int, float))):
+            rows.append({"metric": f"autoscale_{name}_scale_up",
+                         "value": float(fleet["peak"] - fleet["start"]),
+                         "unit": "members", "floor": floor})
+        tts = sc.get("time_to_scale_s")
+        if isinstance(tts, (int, float)):
+            row = {"metric": f"autoscale_{name}_time_to_scale_s",
+                   "value": tts, "unit": "s", "direction": "down",
+                   "abs_slack": TIME_TO_SCALE_ABS_SLACK}
+            ceil = sc.get("time_to_scale_ceiling_s")
+            if isinstance(ceil, (int, float)) and ceil > 0:
+                row = {"metric": f"autoscale_{name}_time_to_scale_s",
+                       "value": tts, "unit": "s", "ceiling": ceil}
+            rows.append(row)
+        rec = sc.get("recompiles_during_run")
+        if isinstance(rec, (int, float)):
+            rows.append({"metric": f"autoscale_{name}_recompiles",
+                         "value": float(rec), "unit": "programs",
+                         "ceiling": float(
+                             sc.get("recompile_ceiling") or 0.0)})
+    excess = doc.get("fleet_excess_recompiles")
+    if isinstance(excess, (int, float)):
+        rows.append({"metric": "autoscale_fleet_excess_recompiles",
+                     "value": float(excess), "unit": "programs",
+                     "ceiling": float(doc.get("recompile_ceiling")
+                                      or 0.0)})
+    return rows
+
+
 def load_rows(path: str) -> list:
     """Extract metric rows from one trajectory artifact.  Shapes seen in
     the wild: the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
@@ -369,6 +438,8 @@ def load_rows(path: str) -> list:
         return stream_report_rows(doc)
     if isinstance(doc, dict) and doc.get("schema") == "mxr_multimodel_report":
         return multimodel_report_rows(doc)
+    if isinstance(doc, dict) and doc.get("schema") == "mxr_autoscale_report":
+        return autoscale_report_rows(doc)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return startup_rows([doc["parsed"]])
     if isinstance(doc, dict) and "metric" in doc:
@@ -576,12 +647,14 @@ def main(argv=None) -> int:
                          "+ --dir/SLO_r*.json + --dir/REPLICA_r*.json + "
                          "--dir/FABRIC_r*.json + --dir/FLYWHEEL_r*.json "
                          "+ --dir/STREAM_r*.json + "
-                         "--dir/MULTIMODEL_r*.json)")
+                         "--dir/MULTIMODEL_r*.json + "
+                         "--dir/AUTOSCALE_r*.json)")
     ap.add_argument("--dir", default=".",
                     help="where to glob BENCH_r*.json / SLO_r*.json / "
                          "REPLICA_r*.json / FABRIC_r*.json / "
                          "FLYWHEEL_r*.json / STREAM_r*.json / "
-                         "MULTIMODEL_r*.json when no paths given")
+                         "MULTIMODEL_r*.json / AUTOSCALE_r*.json when "
+                         "no paths given")
     ap.add_argument("--threshold", type=float, default=GATE_THRESHOLD,
                     help="allowed fractional drop vs the best prior run "
                          "(default 0.10)")
@@ -598,7 +671,8 @@ def main(argv=None) -> int:
         + sorted(glob.glob(os.path.join(args.dir, "FABRIC_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "FLYWHEEL_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "STREAM_r*.json")))
-        + sorted(glob.glob(os.path.join(args.dir, "MULTIMODEL_r*.json"))))
+        + sorted(glob.glob(os.path.join(args.dir, "MULTIMODEL_r*.json")))
+        + sorted(glob.glob(os.path.join(args.dir, "AUTOSCALE_r*.json"))))
     if not paths:
         print("perf_gate: no BENCH_*.json / SLO_*.json files found",
               file=sys.stderr)

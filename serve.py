@@ -182,6 +182,41 @@ def parse_args():
     parser.add_argument("--probe-interval-s", type=float, default=1.0,
                         dest="probe_interval_s",
                         help="fabric membership probe period")
+    # -- elastic autoscaling (ISSUE 18) — OFF by default: without
+    # --autoscale no CapacityAuthority is ever constructed and the
+    # fabric serves the fixed fleet byte-for-byte as before
+    parser.add_argument("--autoscale", action="store_true",
+                        help="run the capacity authority on the fabric "
+                             "router: forecast demand from queue-depth "
+                             "trends and scale the fleet between "
+                             "--autoscale-min/--autoscale-max by "
+                             "unparking drained members, admitting "
+                             "standbys, or forking local replicas — "
+                             "never recompiling (capacity warms from "
+                             "the shared AOT cache)")
+    parser.add_argument("--autoscale-min", type=int, default=1,
+                        dest="autoscale_min",
+                        help="fleet floor: never drain below this many "
+                             "capacity members")
+    parser.add_argument("--autoscale-max", type=int, default=4,
+                        dest="autoscale_max",
+                        help="fleet ceiling: never grow past this many "
+                             "capacity members")
+    parser.add_argument("--autoscale-target-depth", type=float,
+                        default=4.0, dest="autoscale_target_depth",
+                        help="target utilization: forecast demand "
+                             "(queue depth + inflight) per ready member "
+                             "above which the fleet grows; scale-down "
+                             "needs sustained load below half of it")
+    parser.add_argument("--autoscale-interval-s", type=float, default=1.0,
+                        dest="autoscale_interval_s",
+                        help="capacity authority tick period")
+    parser.add_argument("--autoscale-standby", default="",
+                        dest="autoscale_standby",
+                        help="comma-separated member addresses the "
+                             "authority may admit when demand outgrows "
+                             "the registered fleet (parked members are "
+                             "always preferred — they are already warm)")
     # -- data flywheel request capture (ISSUE 13) — OFF by default: the
     # engine keeps its NULL capture sink (zero hot-path work) unless a
     # capture dir is configured
@@ -725,6 +760,19 @@ def main_fabric(args):
                     n, args.pool_file)
     pool.start()
     router = FabricRouter(pool)
+    authority = None
+    if args.autoscale:
+        from mx_rcnn_tpu.serve import AutoscalerOptions, CapacityAuthority
+        standby = [a.strip()
+                   for a in args.autoscale_standby.split(",") if a.strip()]
+        authority = CapacityAuthority(
+            pool, supervisor=sup, standby=standby,
+            opts=AutoscalerOptions(
+                min_members=args.autoscale_min,
+                max_members=args.autoscale_max,
+                target_depth=args.autoscale_target_depth,
+                interval_s=args.autoscale_interval_s)).start()
+        router.autoscaler = authority
     server = make_fabric_server(router, port=args.port or None,
                                 host=args.host,
                                 unix_socket=args.unix_socket or None)
@@ -744,12 +792,17 @@ def main_fabric(args):
     done.wait()
     logger.info("fabric shutting down: %s", pool.counters)
     server.shutdown()
+    if authority is not None:
+        authority.stop()  # no scale decisions during teardown
     if watcher is not None:
         watcher.stop()
     pool.stop()
     if sup is not None:
         sup.stop()
-    obs.close(extra={"fabric": pool.metrics()})
+    extra = {"fabric": pool.metrics()}
+    if authority is not None:
+        extra["autoscale"] = authority.state()
+    obs.close(extra=extra)
 
 
 def choose_mode(args) -> str:
