@@ -781,6 +781,116 @@ def bench_serve_pool(batch: int, network: str = "resnet101",
             round(readback_per_img, 1), round(host_prep_ms, 3), pool_doc)
 
 
+def bench_serve_cascade(batch: int, network: str = "resnet101",
+                        thresh: float = 0.5):
+    """Steady-state imgs/sec through a two-model cascade (ISSUE 19):
+    every request enters at ``CascadeRouter.submit`` (what the frontend
+    calls with --cascade active), answers from the small model unless
+    the on-device hardness gate escalates it to the big sibling.  Both
+    engines run the fused serve_e2e program — the gate consumes its
+    on-device detections.  Same transport-independent shape as
+    ``bench_serve``; the measured rate includes the gate dispatch and
+    every escalated frame's second (staged-reuse) pass.  Reported as
+    ``serve_imgs_per_sec_cascade`` with ``escalation_rate`` alongside —
+    its OWN baseline series, never compared to the single-model or
+    pool rows (the throughput-vs-big-only floor is loadgen's CASCADE
+    report, where both sides run on the same box in the same run)."""
+    import threading
+
+    from mx_rcnn_tpu.eval.tester import Predictor
+    from mx_rcnn_tpu.models import build_model, init_params
+    from mx_rcnn_tpu.serve import (CascadeRouter, ModelPool, RejectedError,
+                                   ServeEngine, ServeOptions, warmup)
+    from mx_rcnn_tpu.train.checkpoint import denormalize_for_save
+
+    cfg = make_cfg(network)
+    model = build_model(cfg)
+    pool = ModelPool().start()
+    mids = ("small", "big")
+    t_w = time.perf_counter()
+    for i, mid in enumerate(mids):
+        params = denormalize_for_save(
+            init_params(model, cfg, jax.random.PRNGKey(i), batch), cfg)
+        pred = Predictor(model, params, cfg)
+        engine = ServeEngine(pred, cfg, ServeOptions(
+            batch_size=batch, max_delay_ms=5.0,
+            max_queue=max(8 * batch, 16), serve_e2e=True))
+        engine.start(external=True)
+        pool.add_model(mid, cfg, pred, engine)
+        warmup(engine)
+    cascade = CascadeRouter(pool, "small", "big", thresh=thresh)
+    cascade.warmup()  # the gate program compiles before traffic too
+    pool.cascade = cascade
+    warmup_compile_s = time.perf_counter() - t_w
+    cold_start_s = time.perf_counter() - _PROC_T0
+
+    short, long_ = (int(s) for s in cfg.tpu.SCALES[0])
+    rng = np.random.RandomState(0)
+    wave = 8 * batch
+    imgs = []
+    for i in range(wave):
+        h, w = (short, long_) if i % 2 == 0 else (long_, short)
+        dh, dw = rng.randint(0, 32, 2)
+        imgs.append(rng.randint(0, 255, (max(h - dh, 16), max(w - dw, 16), 3),
+                                dtype=np.uint8))
+
+    def submit_retry(img):
+        while True:
+            try:
+                return cascade.submit(img, deadline_ms=0)
+            except RejectedError:
+                time.sleep(2e-3)
+
+    feeders = 4
+    best = None
+    try:
+        for _ in range(4):
+            futs = [None] * wave
+            t0 = time.time()
+
+            def feed(t):
+                for i in range(t, wave, feeders):
+                    futs[i] = submit_retry(imgs[i])
+
+            ts = [threading.Thread(target=feed, args=(t,))
+                  for t in range(feeders)]
+            for th in ts:
+                th.start()
+            for th in ts:
+                th.join()
+            for f in futs:
+                f.result(timeout=600.0)
+            best = max(best or 0.0, wave / (time.time() - t0))
+    finally:
+        # worst engine's tail (the pool convention) + aggregate boundary
+        # accounting across both cascade members
+        p50s, p99s = [], []
+        agg = {}
+        for mid in mids:
+            engine = pool.engine_for(mid)
+            h = engine.hists["serve/request_time"]
+            q50, q99 = h.quantile(0.5), h.quantile(0.99)
+            if q50 is not None:
+                p50s.append(q50)
+            if q99 is not None:
+                p99s.append(q99)
+            for k, v in engine.counters.items():
+                if isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+        readback_per_img = (agg.get("readback_bytes", 0)
+                            / max(agg.get("served", 0), 1))
+        host_prep_ms = (agg.get("host_prep_ms_total", 0.0)
+                        / max(agg.get("requests", 0), 1))
+        cascade_doc = cascade.metrics()
+        pool.stop()
+    return (best,
+            (round(max(p50s) * 1e3, 3) if p50s else None),
+            (round(max(p99s) * 1e3, 3) if p99s else None),
+            round(cold_start_s, 3), round(warmup_compile_s, 3),
+            round(readback_per_img, 1), round(host_prep_ms, 3),
+            cascade_doc)
+
+
 def bench_infer_mask(batch: int, network: str = "resnet101_fpn_mask"):
     """Full Mask R-CNN eval loop (VERDICT round-2 item 6): pred_eval with
     with_masks=True — forward + per-class NMS + mask chunk drain + 28×28
@@ -897,6 +1007,14 @@ def main():
                          "robin across models).  Metric suffixed _mmN — "
                          "its own series; the JSON carries the pool's "
                          "scheduler counters")
+    ap.add_argument("--serve-cascade", action="store_true",
+                    dest="serve_cascade",
+                    help="serve mode: run a small:big cascade behind a "
+                         "CascadeRouter (both engines serve_e2e, the "
+                         "on-device hardness gate escalating) and report "
+                         "imgs/sec as serve_imgs_per_sec_cascade with "
+                         "escalation_rate alongside — its own series, "
+                         "never scored against non-cascade rows")
     ap.add_argument("--pipeline-images", type=int, default=32,
                     dest="pipeline_images",
                     help="pipeline mode: synthetic roidb size per epoch")
@@ -1035,7 +1153,19 @@ def main():
         metric = "infer_imgs_per_sec_mask_eval"
     elif args.mode == "serve":
         serve_pool_doc = None
-        if args.serve_models >= 2:
+        serve_cascade_doc = None
+        if args.serve_cascade:
+            if args.serve_e2e or args.serve_stream or args.serve_models:
+                raise SystemExit("--serve-cascade is exclusive with "
+                                 "--serve-e2e / --serve-stream / "
+                                 "--serve-models")
+            (value, serve_p50_ms, serve_p99_ms, serve_cold_start_s,
+             serve_warmup_s, serve_readback_b, serve_prep_ms,
+             serve_cascade_doc) = bench_serve_cascade(
+                 args.batch, args.network)
+            serve_stream_dpf = serve_stream_skip = None
+            metric = "serve_imgs_per_sec_cascade"
+        elif args.serve_models >= 2:
             if args.serve_e2e or args.serve_stream:
                 raise SystemExit("--serve-models is exclusive with "
                                  "--serve-e2e / --serve-stream")
@@ -1163,6 +1293,29 @@ def main():
             else:
                 vs = round(value / base, 3)
             baseline_method = "pred_eval"
+    elif args.mode == "serve" and args.serve_cascade and not args.cfg:
+        # the cascade serve series gets its own record-on-first-run
+        # baseline per (batch, network): a blended small/big rate is
+        # never comparable to single-model or pool rows, and perf_gate
+        # groups by baseline_method so they never cross
+        key = "value_serve_cascade"
+        if args.batch != 1:
+            key += f"_b{args.batch}"
+        if args.network != "resnet101":
+            key += f"_{args.network}"
+        base_doc = {}
+        if os.path.exists(BASELINE_FILE):
+            with open(BASELINE_FILE) as f:
+                base_doc = json.load(f)
+        base = base_doc.get(key)
+        if base is None:  # first cascade run of this shape: record it
+            base_doc[key] = value
+            with open(BASELINE_FILE, "w") as f:
+                json.dump(base_doc, f)
+            baseline_recorded = True
+        else:
+            vs = round(value / base, 3)
+        baseline_method = "cascade"
 
     out = {
         "metric": metric,
@@ -1200,6 +1353,14 @@ def main():
         # counters ride along for the MULTIMODEL evidence trail
         if serve_pool_doc is not None:
             out["pool"] = serve_pool_doc
+        # cascade phase (--serve-cascade): escalation_rate is its own
+        # ride-along series (keyed by the cascade metric — validated,
+        # never scored against non-cascade rows), the router's counters
+        # and gate-time quantiles alongside for the evidence trail
+        if serve_cascade_doc is not None:
+            out["escalation_rate"] = serve_cascade_doc.get(
+                "escalation_rate")
+            out["cascade"] = serve_cascade_doc
     if opt_acc is not None:
         out["opt_acc"] = opt_acc
     if eval_rates is not None:

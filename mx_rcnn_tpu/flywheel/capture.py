@@ -192,12 +192,20 @@ class RequestCapture:
         ``trace_id`` (optional 5th element) the distributed-trace id the
         request served under — provenance that lets a mined hard example
         link back to the serving trace that produced it.
+
+        An optional 6th element is a dict of EXTRA meta keys merged into
+        the row strictly additively (a key shadowing a legacy field is an
+        error) — the cascade router tags escalated frames with
+        ``{"tags": ["cascade_escalated"]}`` this way, so the miner can
+        see which captures the small model already flagged hard.  Rows
+        without the element are byte-identical to pre-cascade captures.
         """
         spill = None
         with self._lock:
             for entry in entries:
                 pixels, raw_hw, orig_hw, records = entry[:4]
                 trace_id = entry[4] if len(entry) > 4 else None
+                extra = entry[5] if len(entry) > 5 else None
                 self._seen += 1
                 if (self._seen - 1) % self.opts.sample_every != 0:
                     self.counters["sampled_out"] += 1
@@ -225,6 +233,11 @@ class RequestCapture:
                 }
                 if trace_id is not None:
                     meta["trace_id"] = str(trace_id)
+                for k in (extra or {}):
+                    if k in meta:
+                        raise ValueError(f"extra capture meta key {k!r} "
+                                         f"shadows a legacy field")
+                    meta[k] = extra[k]
                 self._pending.append((meta, np.ascontiguousarray(
                     pixels, dtype=np.uint8)))
                 self.counters["captured"] += 1

@@ -1,0 +1,105 @@
+"""The ONE hardness definition shared by mining and serving.
+
+The flywheel miner ranks captured requests by a scalar hardness folded
+from three signals (normalized score entropy, NMS-survivor falloff
+between the loose and strict :data:`~mx_rcnn_tpu.flywheel.capture.
+SCORE_BANDS`, and a weak best guess).  The cascade serving gate routes
+live requests on the *same* scalar: frames the small model finds easy
+are answered cheaply, frames above the threshold escalate to the big
+model — and those are exactly the frames the miner would rank hardest.
+
+Both consumers import from here so the definitions can never drift:
+
+- :func:`hardness` — the host/stats-dict form the miner scores shard
+  rows with (moved verbatim from ``flywheel/miner.py``).
+- :func:`build_device_hardness` — a jit-compiled device program that
+  folds a ``(B, cap, 6)`` detection tensor + validity mask (the
+  ``predict_serve_e2e`` output, still on device) into per-image
+  hardness, reproducing ``hardness(score_stats(records))`` without a
+  host readback of the detections.  The equivalence is pinned by
+  ``tests/test_cascade.py``.
+"""
+
+from .capture import SCORE_BANDS, score_stats
+
+# Signal weights; entropy and disagreement dominate, low-max breaks ties.
+W_ENTROPY = 1.0
+W_DISAGREE = 1.0
+W_LOW_MAX = 0.5
+
+# Upper bound of the hardness scalar (every signal saturated).  The
+# cascade threshold is expressed in [0, 1] of this scale, so
+# ``--cascade-thresh 0`` escalates everything and ``1`` nothing —
+# entropy = 1 requires a uniform positive score mass, which forces
+# max_score > 0, so the bound itself is unreachable.
+HARDNESS_MAX = W_ENTROPY + W_DISAGREE + W_LOW_MAX
+
+
+def hardness(stats):
+    """Scalar hardness of one captured record from its score stats."""
+    bands = stats.get("bands", {})
+    loose = bands.get(f"{SCORE_BANDS[0]:.1f}", 0)
+    strict = bands.get(f"{SCORE_BANDS[-1]:.1f}", 0)
+    disagree = (loose - strict) / max(1, loose)
+    entropy = float(stats.get("entropy", 0.0))
+    low_max = 1.0 - float(stats.get("max_score", 0.0))
+    score = W_ENTROPY * entropy + W_DISAGREE * disagree + W_LOW_MAX * low_max
+    return score, {"entropy": entropy, "disagreement": disagree,
+                   "low_max": low_max}
+
+
+def hardness_from_records(records):
+    """Host reference path: detection records → hardness scalar.
+
+    Exactly what the capture→mine pipeline computes for a served image
+    (``hardness(score_stats(records))``); the device gate must agree
+    with this on identical detections.
+    """
+    score, _ = hardness(score_stats(records))
+    return score
+
+
+def build_device_hardness():
+    """Build the jitted cascade-gate program: ``(dets, valid) → (B,)``.
+
+    ``dets`` is the ``(B, cap, 6)`` ``[x1,y1,x2,y2,score,cls]`` tensor
+    ``predict_serve_e2e`` leaves on device (padded rows zeroed) and
+    ``valid`` its ``(B, cap)`` row mask.  Per image this mirrors
+    :func:`~mx_rcnn_tpu.flywheel.capture.score_stats` +
+    :func:`hardness` term by term:
+
+    - entropy: score-mass entropy over valid rows, normalized by
+      ``log(n)`` (total valid count), zero when ``n <= 1`` or the mass
+      is empty;
+    - disagreement: ``(loose - strict) / max(1, loose)`` survivor
+      falloff between the loose and strict bands;
+    - low max: ``1 - max_score``.
+
+    Imports jax lazily (module import stays CPU/numpy-safe) and runs in
+    float32 — the host reference is float64, so agreement is to float32
+    tolerance, pinned by test.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    loose_t = float(SCORE_BANDS[0])
+    strict_t = float(SCORE_BANDS[-1])
+
+    def fn(dets, valid):
+        v = valid.astype(jnp.float32)                    # (B, cap)
+        s = dets[..., 4].astype(jnp.float32) * v         # zeros off-mask
+        n = v.sum(axis=-1)                               # (B,)
+        total = s.sum(axis=-1)
+        max_score = s.max(axis=-1)
+        p = s / jnp.where(total > 0, total, 1.0)[..., None]
+        plogp = jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0)
+        raw_ent = -plogp.sum(axis=-1) / jnp.log(jnp.maximum(n, 2.0))
+        entropy = jnp.where((n > 1) & (total > 0), raw_ent, 0.0)
+        loose = ((s >= loose_t) & (v > 0)).sum(axis=-1).astype(jnp.float32)
+        strict = ((s >= strict_t) & (v > 0)).sum(axis=-1).astype(jnp.float32)
+        disagree = (loose - strict) / jnp.maximum(1.0, loose)
+        low_max = 1.0 - max_score
+        return (W_ENTROPY * entropy + W_DISAGREE * disagree
+                + W_LOW_MAX * low_max).astype(jnp.float32)
+
+    return jax.jit(fn)

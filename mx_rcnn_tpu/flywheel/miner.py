@@ -38,7 +38,16 @@ import time
 
 from mx_rcnn_tpu import telemetry
 
-from .capture import SCORE_BANDS, list_shards
+from .capture import list_shards
+# The scoring math lives in flywheel/hardness.py, shared with the serve
+# cascade gate so mining and serving rank the same frames hard; the
+# re-exports keep this module's historical import surface intact.
+from .hardness import W_DISAGREE, W_ENTROPY, W_LOW_MAX, hardness
+
+__all__ = ["W_ENTROPY", "W_DISAGREE", "W_LOW_MAX", "hardness",
+           "mine_shards", "mine_member", "fold_rankings",
+           "write_manifest", "load_manifest",
+           "MEMBER_RANKING_SCHEMA", "MANIFEST_SCHEMA", "ENV_MINE_PAUSE_S"]
 
 MEMBER_RANKING_SCHEMA = "mxr_member_ranking"
 
@@ -46,25 +55,7 @@ MEMBER_RANKING_SCHEMA = "mxr_member_ranking"
 # the atomic rename, widening the window a SIGTERM-atomicity test needs.
 ENV_MINE_PAUSE_S = "MXR_FLYWHEEL_MINE_PAUSE_S"
 
-# Signal weights; entropy and disagreement dominate, low-max breaks ties.
-W_ENTROPY = 1.0
-W_DISAGREE = 1.0
-W_LOW_MAX = 0.5
-
 MANIFEST_SCHEMA = "mxr_mined_manifest"
-
-
-def hardness(stats):
-    """Scalar hardness of one captured record from its score stats."""
-    bands = stats.get("bands", {})
-    loose = bands.get(f"{SCORE_BANDS[0]:.1f}", 0)
-    strict = bands.get(f"{SCORE_BANDS[-1]:.1f}", 0)
-    disagree = (loose - strict) / max(1, loose)
-    entropy = float(stats.get("entropy", 0.0))
-    low_max = 1.0 - float(stats.get("max_score", 0.0))
-    score = W_ENTROPY * entropy + W_DISAGREE * disagree + W_LOW_MAX * low_max
-    return score, {"entropy": entropy, "disagreement": disagree,
-                   "low_max": low_max}
 
 
 def mine_shards(capture_dir, top_k=64, min_label_score=0.3, shards=None,

@@ -30,7 +30,11 @@ jitted bucket programs, and the shared ``ops/postprocess`` block that
   cross-model dispatcher interleaving per-model bucket queues by queue
   depth × SLO class, and a device weight-residency manager paging param
   trees host↔device under a byte budget (LRU, pinning, zero recompiles
-  — params are runtime arguments to every program).
+  — params are runtime arguments to every program).  Also home of the
+  cascade router (``--cascade small:big``): requests answer from the
+  cheap model unless an on-device confidence gate — the flywheel
+  miner's hardness, computed from the still-on-device detections —
+  escalates them to the big model with their staged pixels reused.
 * ``fabric``     — the cross-host generalization: a transport-agnostic
   replica pool (local fork children + remote TCP members that ``--join``
   or are registered by address), HTTP-probe-driven membership with
@@ -70,7 +74,9 @@ from mx_rcnn_tpu.serve.frontend import (address_request, address_request_raw,
                                         tcp_http_request, tcp_http_request_raw,
                                         unix_http_request,
                                         unix_http_request_raw)
-from mx_rcnn_tpu.serve.pool import ModelEntry, ModelPool, param_nbytes
+from mx_rcnn_tpu.serve.pool import (FIDELITY_CLASSES, CascadeFuture,
+                                    CascadeRouter, ModelEntry, ModelPool,
+                                    param_nbytes)
 from mx_rcnn_tpu.serve.replica import (CheckpointWatcher, NetFaults,
                                        ReplicaFaults, make_reloader,
                                        reload_engine_params,
@@ -99,5 +105,6 @@ __all__ = ["ServeEngine", "ServeOptions", "ServeFuture", "RejectedError",
            "StreamManager", "StreamOptions", "StaleSeqError",
            "FrameResult", "run_stream_stdio",
            "ModelPool", "ModelEntry", "param_nbytes",
+           "CascadeRouter", "CascadeFuture", "FIDELITY_CLASSES",
            "AutoscalerOptions", "CapacityAuthority",
            "fleet_compile_counters", "fleet_compiled_programs"]

@@ -130,13 +130,20 @@ class ServeOptions:
 class ServeFuture:
     """Completion handle for one submitted request."""
 
-    __slots__ = ("_event", "_result", "_error", "queue_wait_s")
+    __slots__ = ("_event", "_result", "_error", "queue_wait_s",
+                 "hardness", "request")
 
     def __init__(self):
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
         self.queue_wait_s: Optional[float] = None
+        # cascade sidecars, set by the on-device gate when a CascadeRouter
+        # is attached to the serving engine: the per-image hardness scalar
+        # and a backlink to the request (whose staged uint8 buffer an
+        # escalation reuses).  None on every non-cascade path.
+        self.hardness: Optional[float] = None
+        self.request = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -296,6 +303,12 @@ class ServeEngine:
         # section when set.  The engine never calls into it — streaming
         # stays a layer above the batcher.
         self.stream = None
+        # CascadeRouter attaches itself here (on the SMALL model's engine
+        # only): each serve_e2e batch then folds its on-device detections
+        # into per-image hardness before readback.  Cascade-off costs
+        # exactly this one attribute check per batch — the capture /
+        # telemetry contract.
+        self.cascade = None
 
     # -- lifecycle -------------------------------------------------------
 
@@ -551,6 +564,13 @@ class ServeEngine:
                        raw_hw=raw_hw, ratio=ratio, orig_hw=orig_hw,
                        staged=staged, staged_hw=staged_hw, stream=stream,
                        trace=trace)
+        return self._enqueue(req, key, tel, prep_s=prep_s)
+
+    def _enqueue(self, req: _Request, key, tel,
+                 prep_s: float = 0.0) -> ServeFuture:
+        """Shared admission tail of :meth:`submit` / :meth:`submit_staged`:
+        backpressure + shed checks under the lock, queue insert, counters,
+        work signal."""
         with self._cond:
             if self._stop:
                 self.counters["rejected"] += 1
@@ -590,6 +610,40 @@ class ServeEngine:
         if self.on_work is not None:
             self.on_work()
         return req.future
+
+    def submit_staged(self, staged: np.ndarray, raw_hw, ratio, im_info,
+                      orig_hw,
+                      deadline_ms: Optional[float] = None,
+                      stream: Optional[str] = None,
+                      trace: Optional[TraceContext] = None) -> ServeFuture:
+        """Cascade escalation intake: enqueue an ALREADY-STAGED uint8
+        bucket buffer (another engine's serve_e2e ``_Request.image``) with
+        its staging sidecars, skipping ``stage_raw_to_bucket`` entirely —
+        the escalated request reuses the staged pixels byte-for-byte and
+        pays zero host prep.  serve_e2e mode only.  The CascadeRouter
+        verified at construction that both cascade engines share bucket
+        geometry; the shape is re-checked here so a config drift fails
+        loudly instead of silently compiling a foreign shape."""
+        if not self.opts.serve_e2e:
+            raise RejectedError(
+                "submit_staged requires serve_e2e mode (staged uint8 "
+                "buffers are only a program input on the fused path)")
+        key = self.bucket_key(int(orig_hw[0]), int(orig_hw[1]))
+        if tuple(staged.shape[:2]) != key:
+            raise ValueError(
+                f"staged buffer {tuple(staged.shape[:2])} does not match "
+                f"this engine's bucket {key} — cascade models must share "
+                f"bucket geometry (SCALES + strides)")
+        tel = telemetry.get()
+        now = time.monotonic()
+        if deadline_ms is None:
+            deadline_ms = self.opts.deadline_ms
+        deadline = now + deadline_ms / 1e3 if deadline_ms > 0 else None
+        req = _Request(staged, im_info, now, deadline, bucket=key,
+                       raw_hw=raw_hw, ratio=ratio,
+                       orig_hw=(int(orig_hw[0]), int(orig_hw[1])),
+                       stream=stream, trace=trace)
+        return self._enqueue(req, key, tel)
 
     def predict(self, image: np.ndarray,
                 deadline_ms: Optional[float] = None,
@@ -985,6 +1039,12 @@ class ServeEngine:
             t_now = time.perf_counter()
             phases["forward"] = t_now - t_ph
             t_ph = t_now
+        if self.cascade is not None:
+            # on-device confidence gate: fold the (B, cap, 6) detections
+            # into per-image hardness while they are STILL device arrays —
+            # the gate consumes tensors already on device and reads back
+            # (B,) floats, adding zero h2d transfers to the batch
+            self.cascade.gate_batch(dets, dvalid, reqs)
         with tel.span("serve/readback"):
             dets, dvalid = jax.device_get((dets, dvalid))
         if phases is not None:

@@ -111,13 +111,20 @@ def encode_image_payload(img: np.ndarray) -> dict:
 
 
 def _predict_doc(engine: ServeEngine, doc: dict, img,
-                 trace) -> tuple:
+                 trace, cascade=None, model_id=None) -> tuple:
     """The submit+wait core of one predict request — trace-agnostic, so
     the traced and untraced paths produce IDENTICAL response docs (the
-    tracing-off byte-parity contract)."""
+    tracing-off byte-parity contract).  With a ``cascade`` router the
+    submit routes through it instead of the engine and the 200 response
+    grows a ``"cascade"`` provenance field (which model answered and
+    why); cascade-off responses stay byte-for-byte."""
     try:
-        fut = engine.submit(img, deadline_ms=doc.get("deadline_ms"),
-                            trace=trace)
+        if cascade is not None:
+            fut = cascade.submit(img, deadline_ms=doc.get("deadline_ms"),
+                                 trace=trace, model_id=model_id)
+        else:
+            fut = engine.submit(img, deadline_ms=doc.get("deadline_ms"),
+                                trace=trace)
         dets = fut.result(timeout=WAIT_TIMEOUT_S)
     except RejectedError as e:
         return 503, {"error": str(e)}
@@ -129,11 +136,15 @@ def _predict_doc(engine: ServeEngine, doc: dict, img,
         logger.exception("predict failed")
         return 500, {"error": f"{type(e).__name__}: {e}"}
     qms = (fut.queue_wait_s or 0.0) * 1e3
-    return 200, {"detections": dets, "queue_wait_ms": round(qms, 3)}
+    resp = {"detections": dets, "queue_wait_ms": round(qms, 3)}
+    if cascade is not None:
+        resp["cascade"] = fut.provenance()
+    return 200, resp
 
 
 def handle_request_doc(engine: ServeEngine, doc: dict,
-                       trace_header: Optional[str] = None) -> tuple:
+                       trace_header: Optional[str] = None,
+                       cascade=None, model_id=None) -> tuple:
     """One predict request → (http_status, response_doc).  Shared by all
     three transports so their status semantics cannot drift.
 
@@ -151,7 +162,8 @@ def handle_request_doc(engine: ServeEngine, doc: dict,
     tracer = tracectx.get()
     raw = trace_header or doc.get("trace")
     if not tracer.enabled:
-        status, resp = _predict_doc(engine, doc, img, None)
+        status, resp = _predict_doc(engine, doc, img, None,
+                                    cascade=cascade, model_id=model_id)
         if raw:
             # propagation without recording: a client that minted an id
             # still gets it echoed so cross-host correlation never
@@ -160,7 +172,8 @@ def handle_request_doc(engine: ServeEngine, doc: dict,
         return status, resp
     ctx = (TraceContext.parse(raw) if raw else None) or tracer.mint()
     with tracer.span(ctx, "frontend/predict") as sp:
-        status, resp = _predict_doc(engine, doc, img, sp.ctx)
+        status, resp = _predict_doc(engine, doc, img, sp.ctx,
+                                    cascade=cascade, model_id=model_id)
         sp.set(status=status)
     resp["trace"] = ctx.trace_id
     return status, resp
@@ -230,6 +243,12 @@ def resolve_stream_frame(res) -> tuple:
            "queue_wait_ms": round((res.queue_wait_s or 0.0) * 1e3, 3)}
     if res.delta is not None:
         out["delta"] = round(res.delta, 4)
+    # cascade provenance, only for cascade-routed streams — non-cascade
+    # frames (and pre-cascade fakes in tests) stay byte-for-byte
+    prov = getattr(res, "cascade", None)
+    prov = prov() if callable(prov) else None
+    if prov is not None:
+        out["cascade"] = prov
     return 200, out
 
 
@@ -281,6 +300,7 @@ class _Handler(BaseHTTPRequestHandler):
     stream: Optional[StreamManager] = None  # enables POST /stream
     pool = None          # optional ModelPool: enables ?model=... routing
     streams = None       # pool mode: {model_id: StreamManager}
+    cascade = None       # optional CascadeRouter: /predict rides it
     reloader = None      # optional callback(doc) -> (status, doc)
     request_hook = None  # optional callback(status) after each /predict
     gate = None          # optional callback() before any handling
@@ -429,8 +449,19 @@ class _Handler(BaseHTTPRequestHandler):
             if self.request_hook is not None:
                 self.request_hook(err[0])
             return
+        mid = None
+        if self.cascade is not None:
+            # the router routes by model IDENTITY (addressed big model /
+            # fidelity pin / bypass / gate), so it needs the id, not the
+            # engine _resolve_engine already validated
+            mid = query_model(query) if query else None
+            if mid is None:
+                m = doc.get("model")
+                if isinstance(m, str) and m:
+                    mid = m
         status, resp = handle_request_doc(
-            engine, doc, trace_header=self.headers.get(TRACE_HEADER))
+            engine, doc, trace_header=self.headers.get(TRACE_HEADER),
+            cascade=self.cascade, model_id=mid)
         self._reply(status, resp)
         if self.request_hook is not None:
             self.request_hook(status)
@@ -461,7 +492,7 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
                 unix_socket: Optional[str] = None,
                 reloader=None, request_hook=None, gate=None,
                 net_faults=None, stream: Optional[StreamManager] = None,
-                pool=None, streams: Optional[dict] = None):
+                pool=None, streams: Optional[dict] = None, cascade=None):
     """Build (not start) the HTTP server — exactly one of ``port`` /
     ``unix_socket``.  Caller owns ``serve_forever``/``shutdown``.
 
@@ -489,6 +520,7 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
     Handler.stream = stream  # a StreamManager enables POST /stream
     Handler.pool = pool
     Handler.streams = streams
+    Handler.cascade = cascade  # a CascadeRouter: /predict rides the gate
     # staticmethod: a plain function stored on the class would otherwise
     # bind as a method and receive the handler as a bogus first argument
     Handler.reloader = staticmethod(reloader) if reloader else None

@@ -48,7 +48,13 @@ from typing import Dict, List, Optional
 
 from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.logger import logger
-from mx_rcnn_tpu.telemetry import tracectx
+from mx_rcnn_tpu.telemetry import Hist, tracectx
+
+# tenant fidelity classes (--model-arg ID:fidelity=...): "cascade" routes
+# the tenant's traffic through the confidence gate, "full" pins it to the
+# big model unconditionally — the SLO escape hatch for tenants whose
+# accuracy budget admits no small-model answers
+FIDELITY_CLASSES = ("cascade", "full")
 
 
 def param_nbytes(tree) -> int:
@@ -74,12 +80,12 @@ class ModelEntry:
     """One registered model: identity, compute, policy, residency."""
 
     __slots__ = ("model_id", "cfg", "predictor", "engine", "controller",
-                 "pinned", "weight", "resident", "bytes", "host_params",
-                 "last_use", "last_sched", "batches", "page_ins",
-                 "page_outs")
+                 "pinned", "weight", "fidelity", "resident", "bytes",
+                 "host_params", "last_use", "last_sched", "batches",
+                 "page_ins", "page_outs")
 
     def __init__(self, model_id, cfg, predictor, engine, controller=None,
-                 pinned=False, weight=1.0):
+                 pinned=False, weight=1.0, fidelity="cascade"):
         self.model_id = model_id
         self.cfg = cfg
         self.predictor = predictor
@@ -87,6 +93,10 @@ class ModelEntry:
         self.controller = controller
         self.pinned = bool(pinned)
         self.weight = max(float(weight), 1e-3)
+        if fidelity not in FIDELITY_CLASSES:
+            raise ValueError(f"fidelity must be one of {FIDELITY_CLASSES}, "
+                             f"got {fidelity!r}")
+        self.fidelity = fidelity
         self.resident = True        # params arrive placed by construction
         self.bytes = param_nbytes(getattr(predictor, "params", None))
         self.host_params = None     # host snapshot while paged out
@@ -115,17 +125,23 @@ class ModelPool:
         self._last_model: Optional[str] = None
         self.counters = {"weight_page_in": 0, "weight_page_out": 0,
                          "sched_batches": 0, "sched_switches": 0}
+        # CascadeRouter, when --cascade is configured; /metrics grows a
+        # "cascade" section.  The pool never calls into it — the router
+        # sits a layer above the scheduler (its escalations arrive as
+        # ordinary big-model submits the dispatcher interleaves).
+        self.cascade = None
 
     # -- registry --------------------------------------------------------
 
     def add_model(self, model_id: str, cfg, predictor, engine,
                   controller=None, pinned: bool = False,
-                  weight: float = 1.0) -> ModelEntry:
+                  weight: float = 1.0,
+                  fidelity: str = "cascade") -> ModelEntry:
         if not model_id or "/" in model_id:
             raise ValueError(f"bad model id {model_id!r}")
         entry = ModelEntry(model_id, cfg, predictor, engine,
                            controller=controller, pinned=pinned,
-                           weight=weight)
+                           weight=weight, fidelity=fidelity)
         with self._lock:
             if model_id in self._entries:
                 raise ValueError(f"model {model_id!r} already registered")
@@ -466,15 +482,391 @@ class ModelPool:
             for k, v in (doc.get("counters") or {}).items():
                 if isinstance(v, (int, float)):
                     agg[k] = agg.get(k, 0) + v
-        return {"multimodel": True,
-                "default_model": order[0] if order else None,
-                "models": models,
-                "counters": agg,
-                "queue_depth": sum(d.get("queue_depth", 0)
-                                   for d in models.values()),
-                "ready": bool(models) and all(d.get("ready")
-                                              for d in models.values()),
-                "pool": {"counters": pool_counters,
-                         "batches": batches,
-                         "last_model": self._last_model},
-                "residency": self.residency()}
+        out = {"multimodel": True,
+               "default_model": order[0] if order else None,
+               "models": models,
+               "counters": agg,
+               "queue_depth": sum(d.get("queue_depth", 0)
+                                  for d in models.values()),
+               "ready": bool(models) and all(d.get("ready")
+                                             for d in models.values()),
+               "pool": {"counters": pool_counters,
+                        "batches": batches,
+                        "last_model": self._last_model},
+               "residency": self.residency()}
+        if self.cascade is not None:
+            out["cascade"] = self.cascade.metrics()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Cascade serving (ISSUE 19): cheap model first, escalate the hard frames.
+
+
+class CascadeFuture:
+    """Completion handle for one cascade-routed request.
+
+    Duck-compatible with :class:`~mx_rcnn_tpu.serve.engine.ServeFuture`
+    (``result`` / ``done`` / ``queue_wait_s`` / ``_error``) so the
+    frontend and the stream layer can hold either.  In ``gate`` mode the
+    escalation decision is taken exactly once, on the first ``result``
+    call, from the hardness the on-device gate stamped on the small
+    model's future — concurrent resolvers agree on one decision and one
+    escalated submit.
+    """
+
+    __slots__ = ("_router", "_fut", "_mode", "_model", "_reason",
+                 "_deadline_ms", "_lock", "_decided", "_escalated",
+                 "_big_fut", "_counted_big")
+
+    def __init__(self, router, fut, mode, model, reason=None,
+                 deadline_ms=None):
+        self._router = router
+        self._fut = fut              # small fut (gate) or the final fut
+        self._mode = mode            # "gate" | "direct"
+        self._model = model
+        self._reason = reason
+        self._deadline_ms = deadline_ms
+        self._lock = threading.Lock()
+        self._decided = False
+        self._escalated = False
+        self._big_fut = None
+        self._counted_big = False
+
+    def done(self) -> bool:
+        with self._lock:
+            big = self._big_fut
+            decided = self._decided
+        if big is not None:
+            return big.done()
+        if self._mode == "direct" or decided:
+            return self._fut.done()
+        return False  # gate verdict pending — result() takes it
+
+    @property
+    def _error(self):
+        with self._lock:
+            big = self._big_fut
+        src = big if big is not None else self._fut
+        return getattr(src, "_error", None)
+
+    @property
+    def queue_wait_s(self):
+        """Total queue residence the client paid: the small model's wait
+        plus, for escalated frames, the big model's."""
+        total = self._fut.queue_wait_s
+        with self._lock:
+            big = self._big_fut
+        if big is not None and big.queue_wait_s is not None:
+            total = (total or 0.0) + big.queue_wait_s
+        return total
+
+    def result(self, timeout=None):
+        if self._mode == "direct":
+            return self._fut.result(timeout)
+        records = self._fut.result(timeout)
+        req = None
+        with self._lock:
+            if not self._decided:
+                self._decided = True
+                h = self._fut.hardness
+                req = self._fut.request
+                if (h is not None and req is not None
+                        and self._router.should_escalate(h)):
+                    try:
+                        self._big_fut = self._router._escalate(
+                            req, deadline_ms=self._deadline_ms)
+                        self._escalated = True
+                    except Exception:
+                        # big model refused (queue full, draining):
+                        # degrade gracefully to the small answer instead
+                        # of turning a served request into a 503
+                        self._router._note_escalation_rejected()
+                if not self._escalated:
+                    self._router._note_answered_small()
+            big = self._big_fut
+        if big is None:
+            return records
+        out = big.result(timeout)
+        with self._lock:
+            first = not self._counted_big
+            self._counted_big = True
+            req = self._fut.request
+        if first and req is not None:
+            self._router._note_escalated_result(req, out)
+        return out
+
+    def provenance(self) -> dict:
+        """The ``cascade`` response field: which model answered and why."""
+        if self._mode == "direct":
+            doc = {"model": self._model, "escalated": False}
+            if self._reason:
+                doc["reason"] = self._reason
+            return doc
+        with self._lock:
+            esc = self._escalated
+        doc = {"model": self._router.big if esc else self._router.small,
+               "escalated": esc, "thresh": self._router.thresh}
+        h = self._fut.hardness
+        if h is not None:
+            doc["hardness"] = round(float(h), 4)
+        return doc
+
+
+class CascadeRouter:
+    """Accuracy-aware request router over a (small, big) model pair.
+
+    Every gated request first hits the SMALL model; the on-device
+    confidence gate — the registry program ``kind="cascade_gate"``,
+    AOT-markered and warm-boot loadable exactly like the stream layer's
+    ``frame_delta`` — folds the small model's still-on-device
+    ``(B, cap, 6)`` detections into per-image hardness (the shared
+    ``flywheel/hardness.py`` definition, so serving and mining can never
+    drift) and stamps it on each request's future before readback: zero
+    extra h2d transfers.  Frames whose hardness clears
+    ``thresh * HARDNESS_MAX`` re-submit to the BIG model through
+    :meth:`~mx_rcnn_tpu.serve.engine.ServeEngine.submit_staged` — the
+    staged uint8 buffer is reused byte-for-byte, never re-staged — and
+    ride the ordinary pool scheduler.  Escalated frames also feed the
+    flywheel capture ring tagged ``cascade_escalated`` with the big
+    model's records: serving traffic mines exactly the examples the
+    small model needs.
+
+    Routing per tenant (the addressed model id): the small/default
+    entry gates; the big entry is served directly ("addressed"); an
+    entry with ``fidelity="full"`` pins to the big model ("fidelity" —
+    the per-SLO-class escape hatch); any other pool sibling bypasses
+    the cascade untouched.
+    """
+
+    KIND = "cascade_gate"
+
+    def __init__(self, pool: ModelPool, small: str, big: str,
+                 thresh: float = 0.5):
+        if small == big:
+            raise ValueError("--cascade needs two DISTINCT models, got "
+                             f"{small!r} twice")
+        if not 0.0 <= float(thresh) <= 1.0:
+            raise ValueError(f"cascade thresh must be in [0, 1], got "
+                             f"{thresh}")
+        from mx_rcnn_tpu.flywheel.hardness import (HARDNESS_MAX,
+                                                   build_device_hardness)
+
+        self.pool = pool
+        self.small = small
+        self.big = big
+        self.thresh = float(thresh)
+        self._thresh_raw = self.thresh * HARDNESS_MAX
+        self.small_entry = pool.entry(small)   # KeyError = unknown model
+        self.big_entry = pool.entry(big)
+        se, be = self.small_entry.engine, self.big_entry.engine
+        for eng, mid in ((se, small), (be, big)):
+            if not eng.opts.serve_e2e:
+                raise ValueError(
+                    f"--cascade requires --serve-e2e on every cascade "
+                    f"model (the gate consumes the fused program's "
+                    f"on-device detections); model {mid!r} is not e2e")
+        # escalation reuses the small model's staged buffers, so both
+        # engines must agree on bucket geometry for every orientation
+        for h, w in ((100, 200), (200, 100)):
+            if se.bucket_key(h, w) != be.bucket_key(h, w):
+                raise ValueError(
+                    f"cascade models disagree on bucket geometry "
+                    f"({small}: {se.bucket_key(h, w)} vs {big}: "
+                    f"{be.bucket_key(h, w)} for a {h}x{w} image) — "
+                    f"escalation cannot reuse staged pixels; align "
+                    f"SCALES and strides")
+        self._lock = threading.Lock()
+        self.counters = {"answered_small": 0, "escalated": 0,
+                         "forced_big": 0, "gate_batches": 0,
+                         "escalation_rejected": 0}
+        self.hists = {"cascade/gate_time": Hist(),
+                      "cascade/hardness": Hist()}
+        # registry citizenship: the gate program registers on the SMALL
+        # model's registry (it consumes that model's detections), giving
+        # it AOT markers + warm-boot accounting like any other program
+        self._registry = getattr(se, "registry", None)
+        if self._registry is not None:
+            self._registry.register(self.KIND,
+                                    lambda: build_device_hardness())
+            self._fn = self._registry.lookup(self.KIND)
+        else:
+            self._fn = build_device_hardness()
+        # escalated frames feed the pool's capture ring (the sink hangs
+        # off the default/small engine; NULL sink when capture is off)
+        self.capture = se.capture
+        se.cascade = self
+
+    # -- the on-device gate ---------------------------------------------
+
+    def _dispatch_gate(self, dets, dvalid):
+        """Run the gate program on the still-on-device detection tensors;
+        returns (hardness ndarray, wall seconds).  First-dispatch
+        accounting goes through the registry like every other program."""
+        import numpy as np
+
+        reg = self._registry
+        shape = tuple(dets.shape)
+        first = reg.note_dispatch(self.KIND, shape) \
+            if reg is not None else False
+        t0 = time.perf_counter()
+        hard = np.asarray(self._fn(dets, dvalid))  # (B,) readback
+        dt = time.perf_counter() - t0
+        if first and reg is not None:
+            reg.record_compile_seconds(self.KIND, shape, dt)
+        return hard, dt
+
+    def gate_batch(self, dets, dvalid, reqs) -> None:
+        """Engine hook (small model's ``_forward_e2e``): stamp per-image
+        hardness + a request backlink on each future, observe gate cost,
+        and emit the PR-16 trace span carrying the gate verdict."""
+        hard, dt = self._dispatch_gate(dets, dvalid)
+        tel = telemetry.get()
+        self.hists["cascade/gate_time"].observe(dt)
+        tel.observe("cascade/gate_time", dt)
+        with self._lock:
+            self.counters["gate_batches"] += 1
+        tel.counter("cascade/gate_batches")
+        tracer = tracectx.get()
+        for b, r in enumerate(reqs):
+            h = float(hard[b])
+            r.future.hardness = h
+            r.future.request = r
+            self.hists["cascade/hardness"].observe(h)
+            ctx = r.trace
+            if tracer.enabled and ctx is not None and ctx.sampled:
+                tracer.record(ctx, "cascade/gate", dt,
+                              attrs={"hardness": round(h, 4),
+                                     "escalate": bool(
+                                         self.should_escalate(h)),
+                                     "thresh": self.thresh,
+                                     "small": self.small,
+                                     "big": self.big})
+
+    def should_escalate(self, hardness: float) -> bool:
+        """thresh 0 escalates everything (>= comparison), 1 nothing
+        (the bound is unreachable) — the threshold-sweep contract."""
+        return hardness >= self._thresh_raw
+
+    def warmup(self) -> int:
+        """Compile the gate program before traffic (and before
+        ``mark_ready``): one dispatch on a zeros detection tensor of the
+        steady-state shape — identical for both orientation buckets, so
+        one program covers them.  Returns new registry programs (0 on a
+        warm boot where only the AOT marker is re-probed... the program
+        still counts once per process; callers compare aot_hit)."""
+        import jax
+        import numpy as np
+
+        eng = self.small_entry.engine
+        B = eng.opts.batch_size
+        mpi = int(self.small_entry.cfg.TEST.MAX_PER_IMAGE)
+        before = self._registry.counters["programs"] \
+            if self._registry is not None else 0
+        dets = jax.device_put(np.zeros((B, mpi, 6), np.float32))
+        dvalid = jax.device_put(np.zeros((B, mpi), bool))
+        self._dispatch_gate(dets, dvalid)
+        after = self._registry.counters["programs"] \
+            if self._registry is not None else before
+        return after - before
+
+    # -- routing ---------------------------------------------------------
+
+    def submit(self, image, deadline_ms=None, stream=None, trace=None,
+               model_id=None) -> CascadeFuture:
+        """Route one request.  Raises ``KeyError`` for an unknown model
+        id (the frontend's 404) and the engine's admission errors."""
+        entry = self.pool.entry(model_id)
+        mid = entry.model_id
+        tel = telemetry.get()
+        if mid == self.big:
+            fut = entry.engine.submit(image, deadline_ms=deadline_ms,
+                                      stream=stream, trace=trace)
+            return CascadeFuture(self, fut, "direct", mid,
+                                 reason="addressed")
+        if entry.fidelity == "full":
+            with self._lock:
+                self.counters["forced_big"] += 1
+            tel.counter("cascade/forced_big")
+            fut = self.big_entry.engine.submit(
+                image, deadline_ms=deadline_ms, stream=stream, trace=trace)
+            return CascadeFuture(self, fut, "direct", self.big,
+                                 reason="fidelity")
+        if mid != self.small:
+            # a pool sibling outside the cascade pair: untouched
+            fut = entry.engine.submit(image, deadline_ms=deadline_ms,
+                                      stream=stream, trace=trace)
+            return CascadeFuture(self, fut, "direct", mid, reason="bypass")
+        fut = entry.engine.submit(image, deadline_ms=deadline_ms,
+                                  stream=stream, trace=trace)
+        return CascadeFuture(self, fut, "gate", mid,
+                             deadline_ms=deadline_ms)
+
+    # -- decision bookkeeping (called by CascadeFuture, once each) -------
+
+    def _escalate(self, req, deadline_ms=None):
+        fut = self.big_entry.engine.submit_staged(
+            req.image, req.raw_hw, req.ratio, req.im_info, req.orig_hw,
+            deadline_ms=deadline_ms, stream=req.stream, trace=req.trace)
+        tel = telemetry.get()
+        with self._lock:
+            self.counters["escalated"] += 1
+            rate = self._rate_locked()
+        tel.counter("cascade/escalated")
+        tel.gauge("cascade/escalation_rate", rate)
+        return fut
+
+    def _note_answered_small(self):
+        tel = telemetry.get()
+        with self._lock:
+            self.counters["answered_small"] += 1
+            rate = self._rate_locked()
+        tel.counter("cascade/answered_small")
+        tel.gauge("cascade/escalation_rate", rate)
+
+    def _note_escalation_rejected(self):
+        with self._lock:
+            self.counters["escalation_rejected"] += 1
+        telemetry.get().counter("cascade/escalation_rejected")
+
+    def _note_escalated_result(self, req, records):
+        """Big model answered an escalated frame: feed the capture ring,
+        tagged, with the BIG model's records as the pseudo-labels — the
+        small model's miss becomes its next training example."""
+        cap = self.capture
+        if cap is None or not cap.enabled:
+            return
+        trace_id = req.trace.trace_id if req.trace is not None else None
+        cap.record_batch(
+            [(req.image, req.raw_hw, req.orig_hw, records, trace_id,
+              {"tags": ["cascade_escalated"]})],
+            self.big_entry.engine.generation)
+
+    def _rate_locked(self) -> float:
+        dec = self.counters["answered_small"] + self.counters["escalated"]
+        return self.counters["escalated"] / max(1, dec)
+
+    def escalation_rate(self) -> float:
+        with self._lock:
+            return self._rate_locked()
+
+    # -- introspection ---------------------------------------------------
+
+    def metrics(self) -> dict:
+        with self._lock:
+            counters = dict(self.counters)
+            rate = self._rate_locked()
+        out = {"small": self.small, "big": self.big,
+               "thresh": self.thresh,
+               "counters": counters,
+               "escalation_rate": round(rate, 4)}
+        stats = {}
+        for q, tag in ((0.5, "p50"), (0.99, "p99")):
+            v = self.hists["cascade/gate_time"].quantile(q)
+            if v is not None:
+                stats[f"gate_time_{tag}_ms"] = round(v * 1e3, 3)
+            h = self.hists["cascade/hardness"].quantile(q)
+            if h is not None:
+                stats[f"hardness_{tag}"] = round(h, 4)
+        out["latency"] = stats
+        return out

@@ -128,6 +128,13 @@ class FrameResult:
     def queue_wait_s(self) -> Optional[float]:
         return None if self.skipped else self._future.queue_wait_s
 
+    def cascade(self) -> Optional[dict]:
+        """Cascade provenance of the frame that produced these records —
+        the REFERENCE frame's when skipped — or None when the stream is
+        not cascade-routed.  Call after :meth:`result`."""
+        prov = getattr(self._future, "provenance", None)
+        return prov() if prov is not None else None
+
 
 class _StreamState:
     __slots__ = ("stream_id", "last_seq", "bucket", "ref_dev", "ref_future",
@@ -155,8 +162,15 @@ class StreamManager:
     to a local jit — same math, no AOT markers."""
 
     def __init__(self, engine: ServeEngine,
-                 options: Optional[StreamOptions] = None, registry=None):
+                 options: Optional[StreamOptions] = None, registry=None,
+                 cascade=None):
         self.engine = engine
+        # a CascadeRouter (attached to this engine's model as the SMALL
+        # side): forwarded frames route through it, so a hard frame's
+        # answer escalates to the big model exactly like /predict.  The
+        # frame-delta skip gate is untouched — a skip replays the
+        # reference frame's (possibly escalated) records.
+        self.cascade = cascade
         self.opts = options or StreamOptions()
         self._streams: Dict[str, _StreamState] = {}
         self._lock = threading.Lock()  # guards _streams + counters
@@ -341,9 +355,15 @@ class StreamManager:
                        "stream": state.stream_id})
         # full path: an ordinary engine request, tagged with its stream
         # so the dispatcher's flush bookkeeping can count cross-stream
-        # batch sharing
-        fut = self.engine.submit(image, deadline_ms=deadline_ms,
-                                 stream=state.stream_id, trace=trace)
+        # batch sharing; with a cascade attached it rides the router so
+        # hard frames escalate to the big model
+        if self.cascade is not None:
+            fut = self.cascade.submit(image, deadline_ms=deadline_ms,
+                                      stream=state.stream_id, trace=trace,
+                                      model_id=self.cascade.small)
+        else:
+            fut = self.engine.submit(image, deadline_ms=deadline_ms,
+                                     stream=state.stream_id, trace=trace)
         state.ref_future = fut
         state.generation = self.engine.generation
         state.skip_run = 0

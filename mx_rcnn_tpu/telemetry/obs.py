@@ -484,6 +484,19 @@ def pool_summary(pool) -> dict:
         gauges[f"serve/weight_bytes/{mid}"] = point(doc["bytes"])
         counters[f"serve/weight_page_in/{mid}"] = doc["page_ins"]
         counters[f"serve/weight_page_out/{mid}"] = doc["page_outs"]
+    # cascade routing state (pool.metrics() carries it only when a
+    # CascadeRouter is attached): decision counters as cascade/*, the
+    # live escalation rate and gate-cost quantiles as gauges — the same
+    # one-metrics-path contract as the flywheel/stream folds, and what
+    # the smoke script's escalation_rate-in-(0,1) probe scrapes
+    cas = pool.cascade.metrics() if pool.cascade is not None else None
+    if cas:
+        for key, v in (cas.get("counters") or {}).items():
+            counters[f"cascade/{key}"] = v
+        gauges["cascade/escalation_rate"] = point(cas["escalation_rate"])
+        gauges["cascade/thresh"] = point(cas["thresh"])
+        for key, v in (cas.get("latency") or {}).items():
+            gauges[f"cascade/{key}"] = point(v)
     return {"spans": {}, "counters": counters, "gauges": gauges,
             "hists": {}}
 

@@ -142,6 +142,19 @@ TRACE_COUNTERS = (
     "trace/tail_kept",
 )
 
+# cascade serving's routing decisions (serve/pool.py CascadeRouter):
+# rendered as their own section — zeros included — whenever the stream
+# carries any cascade/* event, so "did the gate actually run, and what
+# fraction of traffic escalated?" is one greppable block
+# (script/cascade_smoke.sh reads it)
+CASCADE_COUNTERS = (
+    "cascade/answered_small",
+    "cascade/escalated",
+    "cascade/forced_big",
+    "cascade/gate_batches",
+    "cascade/escalation_rejected",
+)
+
 
 def event_files(paths: Iterable[str]) -> List[str]:
     """Expand run dirs to their per-rank event files; pass files through.
@@ -298,6 +311,8 @@ def render_table(summary: dict) -> str:
     pool = any(k in POOL_COUNTERS or k.startswith("serve/weight_page")
                or k.startswith("serve/sched_") for k in counters)
     tracing = any(k.startswith("trace/") for k in counters)
+    cascading = any(k.startswith("cascade/") for k in counters) or any(
+        k.startswith("cascade/") for k in summary.get("gauges", {}))
     pool_extra = sorted(
         n for n in counters if n not in POOL_COUNTERS
         and (n.startswith("serve/weight_page_in/")
@@ -326,6 +341,8 @@ def render_table(summary: dict) -> str:
                 continue  # ditto the model-pool table
             if tracing and name in TRACE_COUNTERS:
                 continue  # ditto the tracing table
+            if cascading and name in CASCADE_COUNTERS:
+                continue  # ditto the cascade table
             lines.append(f"{name:<34}{v:>8}")
         lines.append("")
         lines.append(f"{'recovery event':<34}{'total':>8}")
@@ -364,6 +381,11 @@ def render_table(summary: dict) -> str:
             lines.append("")
             lines.append(f"{'tracing':<34}{'total':>8}")
             for name in TRACE_COUNTERS:
+                lines.append(f"{name:<34}{counters.get(name, 0):>8}")
+        if cascading:
+            lines.append("")
+            lines.append(f"{'cascade':<34}{'total':>8}")
+            for name in CASCADE_COUNTERS:
                 lines.append(f"{name:<34}{counters.get(name, 0):>8}")
     gauges = summary.get("gauges", {})
     if gauges:

@@ -1,0 +1,174 @@
+#!/usr/bin/env bash
+# Cascade serving smoke (CPU-friendly), asserting the --cascade contract
+# end to end on real servers:
+#   1. BIG-ONLY baseline boot (cold --program-cache, single model with
+#      the big deployment's config): steady loadgen records the
+#      always-big imgs/sec the cascade's absolute floor derives from.
+#   2. CASCADE boot (--models small,big --cascade small:big): a probe
+#      pass collects per-request hardness from the "cascade" provenance
+#      field and calibrates the threshold to the observed median — the
+#      README's quantile-calibration workflow, executable.
+#   3. WARM cascade boot at the calibrated threshold: loadgen --cascade
+#      (big_only baseline scenario + gated scenario over identical
+#      payloads) under --assert-2xx writes CASCADE_r01.json; the live
+#      /metrics cascade section must show escalation_rate strictly
+#      inside (0, 1) (the gate actually splits traffic at the median),
+#      zero steady-state recompiles on BOTH engines, and — the warm-
+#      boot acceptance — aot_hit == programs on the small model's
+#      registry with the ``cascade_gate`` program among them: the gate
+#      program rides the persistent cache like every fused forward.
+#   4. scripts/perf_gate.py gates the trajectory including the new
+#      CASCADE rows (speedup_vs_big floor, imgs_per_sec floor,
+#      per-class latency trends).
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${CASCADE_SMOKE_DIR:-/tmp/mxr_cascade_smoke}
+deadline_ms=60000
+rm -rf "$dir"
+mkdir -p "$dir"
+cache="$dir/program_cache"
+tinycfg=(--cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)"
+         --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32)
+# big = same network, one digest-changing override: the realistic
+# small/big two-deployments-one-chip shape (disjoint AOT subtrees)
+ccflags=(--serve-e2e --models small=resnet50,big=resnet50
+         --model-arg "big:cfg=TEST__NMS=0.31")
+
+wait_healthy() {
+  python - "$1" "$2" <<'EOF'
+import os, sys, time
+from mx_rcnn_tpu.serve import unix_http_request
+sock, pid = sys.argv[1], int(sys.argv[2])
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("serve.py exited before becoming healthy")
+    try:
+        status, doc = unix_http_request(sock, "GET", "/healthz", timeout=5)
+        if status == 200:
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1)
+sys.exit("serve.py never became healthy")
+EOF
+}
+
+stop() {  # pid — TERM and poll until gone
+  kill -TERM "$1" 2>/dev/null || true
+  for _ in $(seq 1 100); do
+    kill -0 "$1" 2>/dev/null || return 0
+    sleep 0.2
+  done
+  kill -KILL "$1" 2>/dev/null || true
+}
+
+boot() {  # sock extra-flags... — start serve.py, echo its pid
+  sock="$1"; shift
+  python serve.py --network resnet50 --synthetic --unix-socket "$sock" \
+    --serve-batch 2 --max-delay-ms 50 --max-queue 64 \
+    --deadline-ms "$deadline_ms" --program-cache "$cache" \
+    "${tinycfg[@]}" "$@" >"$sock.log" 2>&1 &
+  echo $!
+}
+
+# ---- 1. big-only baseline ------------------------------------------------
+sock="$dir/bigonly.sock"
+pid=$(boot "$sock" --serve-e2e --cfg TEST__NMS=0.31)
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_healthy "$sock" "$pid"
+python scripts/loadgen.py --unix-socket "$sock" --n 16 --rate 4 \
+  --short 90 --long 120 --deadline-ms "$deadline_ms" --assert-2xx \
+  | tee "$dir/bigonly.out"
+stop "$pid"
+base_tput=$(python - "$dir/bigonly.out" <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip().startswith("{")]
+tput = rows[-1].get("imgs_per_sec")
+assert isinstance(tput, (int, float)) and tput > 0, rows[-1]
+print(tput)
+EOF
+)
+
+# ---- 2. cascade boot: calibrate the threshold from live hardness ---------
+sock="$dir/probe.sock"
+pid=$(boot "$sock" "${ccflags[@]}" --cascade small:big --cascade-thresh 0.5)
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_healthy "$sock" "$pid"
+thresh=$(python - "$sock" <<'EOF'
+import sys
+import numpy as np
+from mx_rcnn_tpu.flywheel.hardness import HARDNESS_MAX
+from mx_rcnn_tpu.serve import encode_image_payload, unix_http_request
+sock = sys.argv[1]
+rng = np.random.RandomState(0)
+hard = []
+for i in range(8):
+    h, w = (90, 120) if i % 2 == 0 else (120, 90)
+    img = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+    status, resp = unix_http_request(sock, "POST", "/predict",
+                                    encode_image_payload(img), timeout=600)
+    assert status == 200, resp
+    prov = resp.get("cascade") or {}
+    assert "hardness" in prov, prov  # every gated answer carries it
+    hard.append(float(prov["hardness"]))
+# the README workflow: pick the quantile that splits the traffic —
+# thresh at the observed median => escalation_rate ~ 0.5
+t = float(np.median(hard)) / HARDNESS_MAX
+print(round(min(max(t, 0.02), 0.98), 4))
+EOF
+)
+stop "$pid"
+echo "calibrated --cascade-thresh $thresh from live hardness"
+
+# ---- 3. warm cascade boot at the calibrated threshold --------------------
+sock="$dir/cascade.sock"
+pid=$(boot "$sock" "${ccflags[@]}" --cascade small:big \
+      --cascade-thresh "$thresh")
+trap 'kill "$pid" 2>/dev/null || true' EXIT
+wait_healthy "$sock" "$pid"
+
+# the cascade must clear an absolute floor too — generous on a shared CI
+# box (the property is that the row is wired, not the number): the gated
+# pass may escalate ~half the frames, so 30% of always-big is safe
+floor=$(python -c "print(round(0.3 * float('$base_tput'), 3))")
+python scripts/loadgen.py --unix-socket "$sock" --n 24 --rate 4 \
+  --short 90 --long 120 --deadline-ms "$deadline_ms" --cascade \
+  --speedup-floor 0.05 --throughput-floor "$floor" --assert-2xx \
+  --report "${CASCADE_OUT:-CASCADE_r01.json}" \
+  | tee "$dir/cascade.out"
+
+python - "$sock" <<'EOF'
+import sys
+from mx_rcnn_tpu.serve import unix_http_request
+status, m = unix_http_request(sys.argv[1], "GET", "/metrics", timeout=30)
+assert status == 200 and "cascade" in m, sorted(m)
+c = m["cascade"]
+assert c["small"] == "small" and c["big"] == "big", c
+dec = c["counters"]["answered_small"] + c["counters"]["escalated"]
+assert dec > 0, c["counters"]
+# the live acceptance: the calibrated gate actually SPLITS the traffic
+assert 0.0 < c["escalation_rate"] < 1.0, c
+assert c["latency"].get("gate_time_p99_ms") is not None, c["latency"]
+for mid in ("small", "big"):
+    ec = m["models"][mid]["counters"]
+    assert ec["recompiles"] == ec["warmup_programs"], (mid, ec)
+# warm-boot acceptance: every program — fused forwards AND the
+# cascade_gate — served from the persistent cache
+small = m["models"]["small"]["compile"]
+kinds = {p["kind"] for p in small["programs"]}
+assert "cascade_gate" in kinds, kinds
+rc = small["counters"]
+assert rc["aot_hit"] == rc["programs"] and rc["programs"] > 0, rc
+print(f"cascade metrics ok: escalation_rate={c['escalation_rate']} "
+      f"({c['counters']['escalated']}/{dec} escalated), 0 steady-state "
+      f"recompiles, {rc['aot_hit']}/{rc['programs']} programs incl. "
+      f"cascade_gate from the persistent cache")
+EOF
+stop "$pid"
+trap - EXIT
+
+# ---- 4. gate the trajectory including the cascade rows -------------------
+python scripts/perf_gate.py
+echo "cascade smoke ok"

@@ -118,6 +118,29 @@ alongside the aggregate fields, and ``--report`` writes schema
 aggregate ``imgs_per_sec`` floor to the mixed row;
 ``--p99-ceiling-ms`` attaches the isolation ceiling the gate enforces
 on every NON-burst model in the burst row.
+
+Cascade mode (ISSUE 19): ``--cascade`` targets a pool serving with a
+cascade router (serve.py --cascade small:big — the pair is discovered
+from the target's ``/metrics`` cascade section, no flags to repeat).
+Two scenarios run over IDENTICAL seeded payloads:
+
+* ``big_only`` — every request addressed straight at the big model
+  (``"model": <big>``, bypassing the gate): the throughput baseline
+  and the agreement reference.
+* ``cascade``  — default routing through the confidence gate; response
+  docs are retained so the ``cascade`` provenance field yields the
+  client-observed ``escalation_rate`` and the per-class
+  (``answered_small`` vs ``escalated``) latency split, and the
+  ``detections`` yield ``agreement`` — mean ``detection_agreement``
+  (the PR-17 promotion-gate metric) against the big-only answers for
+  the same images.
+
+``--report`` writes schema ``mxr_cascade_report``.  The gate pins ride
+the cascade row: ``speedup_vs_big`` (cascade imgs/s over big-only
+imgs/s, floored by ``--speedup-floor``, default 1.0 — the cascade must
+not LOSE to always-big), ``--agreement-floor`` (mean agreement floor),
+and ``--throughput-floor`` (absolute imgs/s floor) — what
+``perf_gate.py`` scores on CASCADE_r*.json.
 """
 
 import argparse
@@ -139,6 +162,7 @@ REPORT_SCHEMA = "mxr_slo_report"
 STREAM_REPORT_SCHEMA = "mxr_stream_report"
 MULTIMODEL_REPORT_SCHEMA = "mxr_multimodel_report"
 AUTOSCALE_REPORT_SCHEMA = "mxr_autoscale_report"
+CASCADE_REPORT_SCHEMA = "mxr_cascade_report"
 REPORT_VERSION = 1
 SCENARIOS = ("steady", "bursty", "size-mix")
 PROFILES = ("diurnal", "flashcrowd")
@@ -271,6 +295,25 @@ def parse_args(argv=None):
                     help="multi-model mode: attach this aggregate "
                          "imgs_per_sec floor to the mixed report row "
                          "(what perf_gate.py enforces)")
+    ap.add_argument("--cascade", action="store_true",
+                    help="cascade mode: the target serves with a "
+                         "cascade router (serve.py --cascade) — run the "
+                         "big_only baseline and gated cascade scenarios "
+                         "over identical payloads and report "
+                         "escalation_rate, per-class p99, and detection "
+                         "agreement vs the big model")
+    ap.add_argument("--speedup-floor", type=float, default=1.0,
+                    dest="speedup_floor",
+                    help="cascade mode: perf_gate floor on cascade "
+                         "imgs_per_sec over big-only imgs_per_sec "
+                         "(default 1.0 — the cascade must not lose to "
+                         "always-big; 0 = no pin)")
+    ap.add_argument("--agreement-floor", type=float, default=0.0,
+                    dest="agreement_floor",
+                    help="cascade mode: perf_gate floor on mean "
+                         "detection agreement between the cascade's "
+                         "answers and the big model's on the same "
+                         "images (0 = no pin)")
     ap.add_argument("--trace-sample", type=float, default=0.0,
                     dest="trace_sample",
                     help="fraction of requests that carry a client-minted"
@@ -804,9 +847,8 @@ def make_stream_frames(rng, motion, n, h, w, cut_every=8):
     return frames
 
 
-def server_counters(args, timeout=10.0):
-    """The target's ``/metrics`` engine counters (``{}`` when
-    unreachable) — diffed around a scenario for ``dispatches_per_frame``."""
+def server_metrics_doc(args, timeout=10.0):
+    """The target's full ``/metrics`` doc (``{}`` when unreachable)."""
     try:
         if args.unix_socket:
             status, doc = unix_http_request(args.unix_socket, "GET",
@@ -824,7 +866,13 @@ def server_counters(args, timeout=10.0):
         return {}
     if status != 200 or not isinstance(doc, dict):
         return {}
-    return doc.get("counters") or {}
+    return doc
+
+
+def server_counters(args, timeout=10.0):
+    """The target's ``/metrics`` engine counters (``{}`` when
+    unreachable) — diffed around a scenario for ``dispatches_per_frame``."""
+    return server_metrics_doc(args, timeout=timeout).get("counters") or {}
 
 
 def run_stream_scenario(args, motion, idx):
@@ -1054,12 +1102,177 @@ def multimodel_main(args):
             sys.exit(1)
 
 
+# -- cascade mode (ISSUE 19) ----------------------------------------------
+
+
+def run_cascade_requests(args, docs, offsets):
+    """:func:`run_requests` with the response doc RETAINED per result —
+    results[i] is ``(status, latency_s, queue_wait_ms, error_str,
+    t_done_s, response_doc)``.  Cascade mode needs the bodies: the
+    ``cascade`` provenance field (escalated flag → per-class split) and
+    the ``detections`` (→ agreement vs the big-only pass)."""
+    n = len(docs)
+    results = [None] * n
+
+    def fire(i):
+        t0 = time.perf_counter()
+        try:
+            if args.unix_socket:
+                status, resp = unix_http_request(
+                    args.unix_socket, "POST", "/predict", docs[i],
+                    timeout=args.timeout)
+            else:
+                status, resp = tcp_request(args.host, args.port, docs[i],
+                                           args.timeout)
+        except Exception as e:  # noqa: BLE001 — a dead server is a result
+            results[i] = (0, time.perf_counter() - t0, None,
+                          f"{type(e).__name__}: {e}",
+                          time.perf_counter() - t_start, {})
+            return
+        results[i] = (status, time.perf_counter() - t0,
+                      resp.get("queue_wait_ms"), None,
+                      time.perf_counter() - t_start, resp)
+
+    t_start = time.perf_counter()
+    threads = []
+    for i in range(n):
+        lag = t_start + offsets[i] - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        th = threading.Thread(target=fire, args=(i,))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    return results, time.perf_counter() - t_start
+
+
+def latency_class_block(results):
+    """p50/p99 over one escalation class of 6-tuple results (the
+    per-class split the CASCADE gate trends)."""
+    lat = np.asarray([r[1] for r in results
+                      if 200 <= r[0] < 300]) * 1e3
+    return {
+        "requests": len(results),
+        "p50_ms": (round(float(np.percentile(lat, 50)), 3)
+                   if lat.size else None),
+        "p99_ms": (round(float(np.percentile(lat, 99)), 3)
+                   if lat.size else None),
+    }
+
+
+def cascade_agreement(cascade_results, big_results):
+    """Mean :func:`detection_agreement` between the cascade's answers
+    and the big model's over the SAME images (index-matched — both
+    passes are built from the same seed), None when no pair completed.
+    The big-only detections are the reference ("labels") side."""
+    from mx_rcnn_tpu.flywheel.fleet import detection_agreement
+    vals = []
+    for c, b in zip(cascade_results, big_results):
+        if not (200 <= c[0] < 300 and 200 <= b[0] < 300):
+            continue
+        vals.append(detection_agreement(c[5].get("detections") or [],
+                                        b[5].get("detections") or []))
+    return round(float(np.mean(vals)), 4) if vals else None
+
+
+def cascade_main(args):
+    """Cascade-mode driver: the ``big_only`` baseline then the gated
+    ``cascade`` scenario over identical payloads; one
+    ``mxr_cascade_report`` doc for the gate."""
+    info = server_metrics_doc(args, timeout=args.timeout).get("cascade")
+    if not isinstance(info, dict) or not info.get("big"):
+        raise SystemExit("loadgen: --cascade target exposes no cascade "
+                         "section on /metrics (serve.py --cascade not "
+                         "active?)")
+    small, big = info.get("small"), info["big"]
+    offsets = schedule("steady", args.n, args.rate)
+    keep = ("requests", "status", "p50_ms", "p99_ms", "error_rate",
+            "availability", "imgs_per_sec", "wall_s")
+    rows, all_results = [], []
+
+    # baseline: the same images addressed straight at the big model —
+    # what the cascade's throughput and answers are scored against
+    docs = make_payloads(args, seed=args.seed)
+    for doc in docs:
+        doc["model"] = big
+    big_results, big_wall = run_cascade_requests(args, docs, offsets)
+    all_results.extend(r[:5] for r in big_results)
+    big_out = summarize([r[:5] for r in big_results], big_wall)
+    rows.append({"name": "big_only", "model": big,
+                 **{k: v for k, v in big_out.items() if k in keep}})
+    print(json.dumps({"scenario": "big_only", **big_out}))
+
+    # the gated pass: identical payloads (same seed), default routing
+    docs = make_payloads(args, seed=args.seed)
+    before = dict(info.get("counters") or {})
+    results, wall = run_cascade_requests(args, docs, offsets)
+    after = server_metrics_doc(args, timeout=args.timeout).get("cascade")
+    all_results.extend(r[:5] for r in results)
+    out = summarize([r[:5] for r in results], wall)
+
+    ok = [r for r in results if 200 <= r[0] < 300]
+    esc = [r for r in ok if (r[5].get("cascade") or {}).get("escalated")]
+    small_ans = [r for r in ok
+                 if not (r[5].get("cascade") or {}).get("escalated")]
+    out["escalation_rate"] = round(len(esc) / max(len(ok), 1), 4)
+    out["classes"] = {"answered_small": latency_class_block(small_ans),
+                      "escalated": latency_class_block(esc)}
+    if isinstance(after, dict):
+        # the server's own view of THIS run (counter delta), the
+        # cross-check script/cascade_smoke.sh asserts against
+        ac, bc = after.get("counters") or {}, before
+        dec = ((ac.get("answered_small", 0) - bc.get("answered_small", 0))
+               + (ac.get("escalated", 0) - bc.get("escalated", 0)))
+        if dec > 0:
+            out["server_escalation_rate"] = round(
+                (ac.get("escalated", 0) - bc.get("escalated", 0)) / dec, 4)
+    agree = cascade_agreement(results, big_results)
+    out["agreement"] = agree
+    big_ips = big_out.get("imgs_per_sec")
+    if big_ips and out.get("imgs_per_sec"):
+        out["big_only_imgs_per_sec"] = big_ips
+        out["speedup_vs_big"] = round(out["imgs_per_sec"] / big_ips, 4)
+    row = {"name": "cascade", "small": small, "big": big,
+           "thresh": info.get("thresh"),
+           **{k: v for k, v in out.items()
+              if k in keep + ("escalation_rate", "server_escalation_rate",
+                              "classes", "agreement",
+                              "big_only_imgs_per_sec", "speedup_vs_big")}}
+    if args.speedup_floor > 0:
+        row["speedup_floor"] = args.speedup_floor
+    if args.agreement_floor > 0:
+        row["agreement_floor"] = args.agreement_floor
+    if args.throughput_floor > 0:
+        row["imgs_per_sec_floor"] = args.throughput_floor
+    rows.append(row)
+    print(json.dumps({"scenario": "cascade", **out}))
+
+    if args.report:
+        doc = {"schema": CASCADE_REPORT_SCHEMA, "version": REPORT_VERSION,
+               "scenarios": rows}
+        with open(args.report, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+
+    if args.assert_2xx:
+        msg = assert_2xx_failure(all_results)
+        if msg is not None:
+            print(msg, file=sys.stderr)
+            sys.exit(1)
+
+
 def main(argv=None):
     args = parse_args(argv)
     if bool(args.unix_socket) == bool(args.port):
         raise SystemExit("pass exactly one of --port / --unix-socket")
     if args.fabric and not args.port:
         raise SystemExit("--fabric needs a TCP router (--port)")
+    if args.cascade:
+        if args.models or args.streams > 0:
+            raise SystemExit("--cascade is exclusive with --models / "
+                             "--streams (the pair comes from the "
+                             "server's /metrics)")
+        return cascade_main(args)
     if args.models:
         if args.streams > 0:
             raise SystemExit("--models and --streams are exclusive")

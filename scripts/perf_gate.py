@@ -101,6 +101,13 @@ FLYWHEEL_PROMOTED_FLOOR = 1.0
 # their own series and conservative first thresholds.
 STREAM_DPF_ABS_SLACK = 0.25
 BENCH_SKIP_FRACTION_FLOOR = 0.5
+# cascade serving (mxr_cascade_report): the cascade must not LOSE to
+# always-big on the same box — imgs/sec over the big-only baseline run
+# floors at 1.0 unless the run pinned its own — and the answers must
+# agree with the big model's (mean detection_agreement floor; the run
+# pins the value, there is no universal default because it depends on
+# how far apart the two checkpoints are)
+CASCADE_SPEEDUP_FLOOR = 1.0
 # time_to_scale is dominated by the autoscaler's tick interval and the
 # member readiness probe cadence, both sub-second in the smoke — a
 # second of absolute noise before the relative trend threshold applies.
@@ -417,6 +424,71 @@ def autoscale_report_rows(doc: dict) -> list:
     return rows
 
 
+def cascade_report_rows(doc: dict) -> list:
+    """Expand an ``mxr_cascade_report`` (scripts/loadgen.py --cascade,
+    script/cascade_smoke.sh) into rows.  The ISSUE-19 properties score
+    the newest run alone: ``speedup_vs_big`` — cascade imgs/sec over the
+    big-only baseline measured in the SAME run — against its FLOOR
+    (default 1.0: a cascade that loses to always-big is pure overhead),
+    and mean ``agreement`` vs the big model's answers against the floor
+    the run pinned (``--agreement-floor``).  An absolute imgs_per_sec
+    floor rides when pinned.  Aggregate and per-class (answered_small /
+    escalated) p50/p99 and error_rate trend as direction=down rows;
+    ``escalation_rate`` is validated but not gated — its live (0, 1)
+    assertion belongs to the smoke script, and its healthy value is a
+    property of the traffic, not the build."""
+    rows = []
+    for sc in doc.get("scenarios", []):
+        name = sc.get("name", "?")
+        for field, unit, slack in (("p50_ms", "ms", 0.0),
+                                   ("p99_ms", "ms", 0.0),
+                                   ("error_rate", "fraction",
+                                    ERROR_RATE_ABS_SLACK)):
+            v = sc.get(field)
+            if not isinstance(v, (int, float)):
+                continue
+            row = {"metric": f"cascade_{name}_{field}", "value": v,
+                   "unit": unit, "direction": "down"}
+            if slack:
+                row["abs_slack"] = slack
+            rows.append(row)
+        for cls, block in sorted((sc.get("classes") or {}).items()):
+            if not isinstance(block, dict):
+                continue
+            for field in ("p50_ms", "p99_ms"):
+                v = block.get(field)
+                if isinstance(v, (int, float)):
+                    rows.append({"metric": f"cascade_{cls}_{field}",
+                                 "value": v, "unit": "ms",
+                                 "direction": "down"})
+        sp = sc.get("speedup_vs_big")
+        if isinstance(sp, (int, float)):
+            rows.append({"metric": "cascade_speedup_vs_big",
+                         "value": round(float(sp), 4), "unit": "ratio",
+                         "floor": sc.get("speedup_floor",
+                                         CASCADE_SPEEDUP_FLOOR)})
+        agree = sc.get("agreement")
+        if isinstance(agree, (int, float)):
+            row = {"metric": "cascade_agreement", "value": agree,
+                   "unit": "fraction"}
+            floor = sc.get("agreement_floor")
+            if isinstance(floor, (int, float)) and floor > 0:
+                row["floor"] = floor
+            rows.append(row)
+        floor = sc.get("imgs_per_sec_floor")
+        tput = sc.get("imgs_per_sec")
+        if (isinstance(floor, (int, float)) and floor > 0
+                and isinstance(tput, (int, float))
+                and name == "cascade"):
+            rows.append({"metric": "cascade_imgs_per_sec",
+                         "value": tput, "unit": "imgs/s", "floor": floor})
+        er = sc.get("escalation_rate")
+        if isinstance(er, (int, float)):
+            rows.append({"metric": f"cascade_{name}_escalation_rate",
+                         "value": er, "unit": "fraction"})
+    return rows
+
+
 def load_rows(path: str) -> list:
     """Extract metric rows from one trajectory artifact.  Shapes seen in
     the wild: the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
@@ -440,6 +512,8 @@ def load_rows(path: str) -> list:
         return multimodel_report_rows(doc)
     if isinstance(doc, dict) and doc.get("schema") == "mxr_autoscale_report":
         return autoscale_report_rows(doc)
+    if isinstance(doc, dict) and doc.get("schema") == "mxr_cascade_report":
+        return cascade_report_rows(doc)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return startup_rows([doc["parsed"]])
     if isinstance(doc, dict) and "metric" in doc:
@@ -491,6 +565,14 @@ def startup_rows(rows: list) -> list:
                         "value": v, "unit": "fraction",
                         "floor": row.get("skip_fraction_floor",
                                          BENCH_SKIP_FRACTION_FLOOR)})
+        # cascade phase (bench.py --serve-cascade): escalation_rate rides
+        # keyed by the cascade metric — validated, not trend-gated (its
+        # healthy value is a property of the traffic, not the build)
+        v = row.get("escalation_rate")
+        if isinstance(v, (int, float)):
+            out.append({"metric":
+                        f"{row.get('metric', '?')}_escalation_rate",
+                        "value": v, "unit": "fraction"})
         ev = row.get("eval")
         if isinstance(ev, dict):
             sp = ev.get("speedup_vs_serial")
@@ -648,13 +730,14 @@ def main(argv=None) -> int:
                          "--dir/FABRIC_r*.json + --dir/FLYWHEEL_r*.json "
                          "+ --dir/STREAM_r*.json + "
                          "--dir/MULTIMODEL_r*.json + "
-                         "--dir/AUTOSCALE_r*.json)")
+                         "--dir/AUTOSCALE_r*.json + "
+                         "--dir/CASCADE_r*.json)")
     ap.add_argument("--dir", default=".",
                     help="where to glob BENCH_r*.json / SLO_r*.json / "
                          "REPLICA_r*.json / FABRIC_r*.json / "
                          "FLYWHEEL_r*.json / STREAM_r*.json / "
-                         "MULTIMODEL_r*.json / AUTOSCALE_r*.json when "
-                         "no paths given")
+                         "MULTIMODEL_r*.json / AUTOSCALE_r*.json / "
+                         "CASCADE_r*.json when no paths given")
     ap.add_argument("--threshold", type=float, default=GATE_THRESHOLD,
                     help="allowed fractional drop vs the best prior run "
                          "(default 0.10)")
@@ -672,7 +755,8 @@ def main(argv=None) -> int:
         + sorted(glob.glob(os.path.join(args.dir, "FLYWHEEL_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "STREAM_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "MULTIMODEL_r*.json")))
-        + sorted(glob.glob(os.path.join(args.dir, "AUTOSCALE_r*.json"))))
+        + sorted(glob.glob(os.path.join(args.dir, "AUTOSCALE_r*.json")))
+        + sorted(glob.glob(os.path.join(args.dir, "CASCADE_r*.json"))))
     if not paths:
         print("perf_gate: no BENCH_*.json / SLO_*.json files found",
               file=sys.stderr)

@@ -260,7 +260,31 @@ def parse_args():
                              "out), weight (scheduling/SLO class, "
                              "default 1.0), target-p99-ms (per-model SLO "
                              "controller target; overrides the global "
-                             "--target-p99-ms)")
+                             "--target-p99-ms), fidelity ('cascade' "
+                             "[default] gates through --cascade, 'full' "
+                             "pins the tenant to the big model "
+                             "unconditionally)")
+    # -- cascade serving (ISSUE 19) — opt-in; without --cascade no router
+    # is built and the --models pool serves byte-for-byte as before
+    parser.add_argument("--cascade", default="", metavar="SMALL:BIG",
+                        help="accuracy-aware model cascade over two "
+                             "--models entries: every gated request "
+                             "first hits SMALL; frames whose on-device "
+                             "confidence-gate hardness (the flywheel "
+                             "miner's definition) clears --cascade-thresh "
+                             "escalate to BIG — the staged pixels are "
+                             "reused, never re-staged, and escalated "
+                             "frames feed the capture ring tagged "
+                             "cascade_escalated.  Requires --models and "
+                             "--serve-e2e")
+    parser.add_argument("--cascade-thresh", type=float, default=0.5,
+                        dest="cascade_thresh",
+                        help="escalation threshold in [0, 1] of the "
+                             "hardness scale: 0 escalates every frame "
+                             "(big-only answers), 1 none (small-only). "
+                             "Calibrate against the live hardness "
+                             "histogram on /metrics (cascade.latency."
+                             "hardness_p50)")
     parser.add_argument("--weight-budget-mb", type=float, default=0.0,
                         dest="weight_budget_mb",
                         help="device weight-residency byte budget for "
@@ -334,7 +358,7 @@ def parse_model_specs(models: str, model_args) -> list:
             raise SystemExit(f"--models: duplicate model id {mid!r}")
         spec = {"id": mid, "network": network, "prefix": None,
                 "epoch": None, "cfg": [], "pin": False, "weight": 1.0,
-                "target_p99_ms": None}
+                "target_p99_ms": None, "fidelity": "cascade"}
         by_id[mid] = spec
         specs.append(spec)
     for arg in model_args or []:
@@ -352,6 +376,8 @@ def parse_model_specs(models: str, model_args) -> list:
             spec["weight"] = float(val)
         elif key == "target_p99_ms":
             spec["target_p99_ms"] = float(val)
+        elif key == "fidelity":
+            spec["fidelity"] = val.strip()
         elif key in ("prefix", "epoch"):
             spec[key] = int(val) if key == "epoch" else val
         else:
@@ -429,9 +455,10 @@ def _build_pool(args):
     config/Predictor/engine (external-dispatch) + per-model warmup, one
     cross-model dispatcher, LRU weight residency under
     --weight-budget-mb, and a per-model SLO controller when a p99 target
-    is set.  Returns (pool, streams) — streams only under --stream."""
-    from mx_rcnn_tpu.serve import (ControllerOptions, ModelPool,
-                                   SLOController, StreamManager,
+    is set.  Returns (pool, streams, cascade) — streams only under
+    --stream, cascade (a warmed CascadeRouter) only under --cascade."""
+    from mx_rcnn_tpu.serve import (CascadeRouter, ControllerOptions,
+                                   ModelPool, SLOController, StreamManager,
                                    StreamOptions, warmup)
 
     specs = parse_model_specs(args.models, args.model_arg)
@@ -464,7 +491,7 @@ def _build_pool(args):
                 window_s=args.slo_window_s, label=spec["id"]))
         pool.add_model(spec["id"], cfg, predictor, engine,
                        controller=controller, pinned=spec["pin"],
-                       weight=spec["weight"])
+                       weight=spec["weight"], fidelity=spec["fidelity"])
         # warm THIS model before building the next: the most recent
         # owning registry points the process-global jax compilation
         # cache at its dtype dir, so compiles must land while their
@@ -479,7 +506,30 @@ def _build_pool(args):
             streams[spec["id"]] = sm
         if controller is not None:
             controller.start()
-    return pool, streams
+    cascade = None
+    if getattr(args, "cascade", ""):
+        small, sep, big = args.cascade.partition(":")
+        small, big = small.strip(), big.strip()
+        if not sep or not small or not big:
+            raise SystemExit(f"--cascade is SMALL:BIG with ids from "
+                             f"--models, got {args.cascade!r}")
+        try:
+            cascade = CascadeRouter(pool, small, big,
+                                    thresh=args.cascade_thresh)
+        except (KeyError, ValueError) as e:
+            raise SystemExit(f"--cascade: {e}")
+        # ready the gate program now, after the per-model warmups — a
+        # cascade boot compiles everything before mark_ready, so the
+        # steady state (and the zero-recompile contract) covers the gate
+        cascade.warmup()
+        pool.cascade = cascade
+        if small in streams:
+            # cascade-route the small model's streams: hard frames of a
+            # camera escalate exactly like hard /predict images
+            streams[small].cascade = cascade
+        logger.info("cascade: %s -> %s at thresh %.3f (gate program "
+                    "warm)", small, big, args.cascade_thresh)
+    return pool, streams, cascade
 
 
 def main_single(args):
@@ -572,13 +622,13 @@ def main_multimodel(args):
                                         "max_delay_ms": args.max_delay_ms},
                               configure_telemetry=True)
     _configure_tracing(args, "server")
-    pool, streams = _build_pool(args)
+    pool, streams, cascade = _build_pool(args)
     default = pool.default_model
     server = make_server(pool.engine_for(default),
                          port=args.port or None, host=args.host,
                          unix_socket=args.unix_socket or None,
                          stream=streams.get(default), pool=pool,
-                         streams=streams)
+                         streams=streams, cascade=cascade)
     done = threading.Event()
     _install_signals(done)
     t = threading.Thread(target=server.serve_forever, name="serve-http",
@@ -824,6 +874,10 @@ def choose_mode(args) -> str:
 
 def main(args):
     mode = choose_mode(args)
+    if getattr(args, "cascade", "") and not getattr(args, "models", ""):
+        raise SystemExit("--cascade routes between two --models entries; "
+                         "pass --models SMALL=...,BIG=... (and "
+                         "--serve-e2e)")
     if getattr(args, "models", ""):
         # the pool shares one device owner (its dispatcher thread); the
         # multi-process planes each bind a full device stack per child,
