@@ -70,7 +70,7 @@ from mx_rcnn_tpu import telemetry
 from mx_rcnn_tpu.logger import logger
 from mx_rcnn_tpu.serve.frontend import (_Handler, _TCPHTTPServer,
                                         _UnixHTTPServer, address_request,
-                                        address_request_raw)
+                                        address_request_raw, query_param)
 from mx_rcnn_tpu.serve.supervisor import (FAILED, READY as SUP_READY,
                                           STOPPED, ReplicaSupervisor,
                                           TokenBucket)
@@ -985,6 +985,7 @@ class FabricRouter:
         self._rr = 0
         self._rr_lock = threading.Lock()
         self.autoscaler = None  # CapacityAuthority, when --autoscale
+        self.watchtower = None  # Watchtower, when --watch/--alert-rules
         self.retry_bucket = TokenBucket(pool.opts.retry_budget,
                                         pool.opts.retry_refill_per_s)
 
@@ -1041,7 +1042,26 @@ class FabricRouter:
         ``X-Mxr-Trace`` (the member's frontend span chains under it).
         Context comes from the client's header, a ``"trace"`` doc field
         sniffed from the opaque body, or a fresh mint; tracing off skips
-        all of it."""
+        all of it.
+
+        With a watchtower attached the router also observes its own
+        end-to-end route latency into ``fabric/route_time`` — the burn-
+        rate rule's signal.  Router-observed is load-bearing: a member-
+        side delay fault (``MXR_FAULT_NET_DELAY_MS``) is injected at the
+        member's HTTP frontend AFTER its engine, so member engine hists
+        never see it; only the router does.  Gated on the watchtower so
+        watch-off keeps the telemetry JSONL stream byte-identical."""
+        if self.watchtower is not None:
+            t0 = time.monotonic()
+            try:
+                return self._route_predict_traced(body, trace_header)
+            finally:
+                telemetry.get().observe("fabric/route_time",
+                                        time.monotonic() - t0)
+        return self._route_predict_traced(body, trace_header)
+
+    def _route_predict_traced(self, body: bytes,
+                              trace_header: Optional[str] = None) -> tuple:
         tracer = tracectx.get()
         if not tracer.enabled:
             return self._route_predict(body, None, NULL_SPAN)
@@ -1212,6 +1232,8 @@ class FabricRouter:
         out["generation"] = self.pool.generation
         if self.autoscaler is not None:
             out["autoscale"] = self.autoscaler.state()
+        if self.watchtower is not None:
+            out["watch"] = self.watchtower.state()
         tracer = tracectx.get()
         if tracer.enabled:
             out["trace"] = tracer.metrics()
@@ -1275,6 +1297,9 @@ def fabric_prometheus(router: FabricRouter) -> str:
     for state in list(known) + sorted(set(counts) - set(known)):
         lines.append(f'fabric_member_count{{state="{state}"}} '
                      f'{counts.get(state, 0)}')
+    if router.watchtower is not None:
+        from mx_rcnn_tpu.telemetry.watch import alert_state_lines
+        lines += alert_state_lines(router.watchtower)
     return text + "\n".join(lines) + "\n"
 
 
@@ -1307,7 +1332,25 @@ class _FabricHandler(_Handler):
                                 PROM_CONTENT_TYPE)
             else:
                 self._reply(200, self.router.metrics())
+        elif path == "/alerts" and self.router.watchtower is not None:
+            self._reply(200, self.router.watchtower.alerts_doc())
+        elif path == "/history" and self.router.watchtower is not None:
+            metric = query_param(query, "metric")
+            if not metric:
+                self._reply(400, {"error": "need ?metric=NAME"})
+                return
+            try:
+                window = float(query_param(query, "window") or 300.0)
+            except ValueError:
+                self._reply(400, {"error": "window must be a number "
+                                           "of seconds"})
+                return
+            self._reply(200,
+                        self.router.watchtower.history_doc(metric,
+                                                           window))
         else:
+            # watch-off: /alerts and /history fall through to the same
+            # 404 as any unknown path — byte parity with PR-19
             self._reply(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):

@@ -294,6 +294,16 @@ def query_model(query: str) -> Optional[str]:
     return None
 
 
+def query_param(query: str, key: str) -> Optional[str]:
+    """Extract ``key=...`` from a raw query string (None if absent) —
+    URL-decoded just enough for metric names (``%2F`` → ``/``)."""
+    for part in query.split("&"):
+        k, _, v = part.partition("=")
+        if k == key and v:
+            return v.replace("%2F", "/").replace("%2f", "/")
+    return None
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     engine: ServeEngine = None  # set by make_server subclassing
@@ -305,6 +315,7 @@ class _Handler(BaseHTTPRequestHandler):
     request_hook = None  # optional callback(status) after each /predict
     gate = None          # optional callback() before any handling
     net_faults = None    # optional NetFaults: intercept(path, handler)
+    watch = None         # optional Watchtower: /alerts + /history + Prom
 
     def _resolve_engine(self, query: str, doc: Optional[dict] = None):
         """``?model=...`` (or a ``"model"`` field in the request doc) →
@@ -379,15 +390,38 @@ class _Handler(BaseHTTPRequestHandler):
             # callers; Prometheus scrapers ask via Accept or ?format=prom
             accept = self.headers.get("Accept", "")
             if "format=prom" in query or "text/plain" in accept:
-                text = (pool_prometheus(self.pool)
+                text = (pool_prometheus(self.pool, watch=self.watch)
                         if self.pool is not None
-                        else serve_prometheus(self.engine))
+                        else serve_prometheus(self.engine,
+                                              watch=self.watch))
                 self._reply_raw(200, text.encode(), PROM_CONTENT_TYPE)
             elif self.pool is not None:
-                self._reply(200, self.pool.metrics())
+                doc = self.pool.metrics()
+                if self.watch is not None:
+                    doc["watch"] = self.watch.state()
+                self._reply(200, doc)
             else:
-                self._reply(200, self.engine.metrics())
+                doc = self.engine.metrics()
+                if self.watch is not None:
+                    doc["watch"] = self.watch.state()
+                self._reply(200, doc)
+        elif path == "/alerts" and self.watch is not None:
+            self._reply(200, self.watch.alerts_doc())
+        elif path == "/history" and self.watch is not None:
+            metric = query_param(query, "metric")
+            if not metric:
+                self._reply(400, {"error": "need ?metric=NAME"})
+                return
+            try:
+                window = float(query_param(query, "window") or 300.0)
+            except ValueError:
+                self._reply(400, {"error": "window must be a number "
+                                           "of seconds"})
+                return
+            self._reply(200, self.watch.history_doc(metric, window))
         else:
+            # /alerts and /history 404 when the watchtower is off —
+            # byte-identical to the pre-watch unknown-path reply
             self._reply(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
@@ -492,7 +526,8 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
                 unix_socket: Optional[str] = None,
                 reloader=None, request_hook=None, gate=None,
                 net_faults=None, stream: Optional[StreamManager] = None,
-                pool=None, streams: Optional[dict] = None, cascade=None):
+                pool=None, streams: Optional[dict] = None, cascade=None,
+                watch=None):
     """Build (not start) the HTTP server — exactly one of ``port`` /
     ``unix_socket``.  Caller owns ``serve_forever``/``shutdown``.
 
@@ -509,7 +544,13 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
     resolves to that model's engine / StreamManager (``streams``:
     model_id → manager), ``/metrics`` reports the whole fleet, and
     ``/readyz`` requires every model warm.  ``engine`` stays the default
-    model's engine so single-model callers are untouched."""
+    model's engine so single-model callers are untouched.
+
+    ``watch`` (a :class:`~mx_rcnn_tpu.telemetry.watch.Watchtower`)
+    enables ``GET /alerts`` and ``GET /history?metric=&window=`` plus
+    the ``watch`` pane / ``mxr_alert_state`` family on ``/metrics``;
+    None keeps every response byte-identical to the watch-less server
+    (both routes 404)."""
     if (port is None) == (unix_socket is None):
         raise ValueError("pass exactly one of port / unix_socket")
 
@@ -528,6 +569,7 @@ def make_server(engine: ServeEngine, port: Optional[int] = None,
                             if request_hook else None)
     Handler.gate = staticmethod(gate) if gate else None
     Handler.net_faults = net_faults
+    Handler.watch = watch  # a Watchtower enables /alerts + /history
     if unix_socket is not None:
         return _UnixHTTPServer(unix_socket, Handler)
     return _TCPHTTPServer((host, port), Handler)

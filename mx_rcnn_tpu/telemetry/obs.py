@@ -68,6 +68,32 @@ def _prom_name(name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
 
 
+_HELP_OVERRIDES = {
+    "mxr_up": "1 for every rank folded into this exposition.",
+    "mxr_snapshot_age_seconds":
+        "Seconds since a peer rank's snapshot file was written.",
+}
+
+
+def _help(fam: str, kind: str) -> str:
+    """One ``# HELP`` text per family — real scrapers warn on HELP-less
+    families, so every ``mxr_*`` family carries one (generic but
+    truthful: the name already says what is measured)."""
+    if fam in _HELP_OVERRIDES:
+        return _HELP_OVERRIDES[fam]
+    if kind == "counter":
+        if fam.endswith("_seconds_total"):
+            return "Total seconds spent, summed over calls."
+        if fam.endswith("_calls_total"):
+            return "Total completed calls."
+        return "Monotone event count since process start."
+    if kind == "histogram":
+        return "Distribution in seconds (log-spaced buckets)."
+    if fam.endswith("_seconds_max"):
+        return "Longest single call observed, in seconds."
+    return "Gauge sampled per rank (stat=last/min/max/mean)."
+
+
 def prometheus_text(per_rank: dict, ages: Optional[dict] = None) -> str:
     """Render ``{rank: summary_dict}`` (the :meth:`Telemetry.summary`
     shape) as Prometheus text exposition.  Families:
@@ -119,14 +145,17 @@ def prometheus_text(per_rank: dict, ages: Optional[dict] = None) -> str:
 
     lines = []
     for fam in sorted(counters):
+        lines.append(f"# HELP {fam} {_help(fam, 'counter')}")
         lines.append(f"# TYPE {fam} counter")
         for rank, v in counters[fam]:
             lines.append(f'{fam}{{rank="{rank}"}} {fmt(v)}')
     for fam in sorted(gauges):
+        lines.append(f"# HELP {fam} {_help(fam, 'gauge')}")
         lines.append(f"# TYPE {fam} gauge")
         for rank, labels, v in gauges[fam]:
             lines.append(f'{fam}{{rank="{rank}"{labels}}} {fmt(v)}')
     for fam in sorted(hists):
+        lines.append(f"# HELP {fam} {_help(fam, 'histogram')}")
         lines.append(f"# TYPE {fam} histogram")
         for rank, h in hists[fam]:
             cum = 0
@@ -455,11 +484,18 @@ def engine_summary(engine) -> dict:
             "gauges": gauges, "hists": hists}
 
 
-def serve_prometheus(engine) -> str:
+def serve_prometheus(engine, watch=None) -> str:
     """The frontend's ``/metrics?format=prom`` body — same renderer and
-    registry as the obs server (one metrics path, not two)."""
+    registry as the obs server (one metrics path, not two).  ``watch``
+    (a :class:`~mx_rcnn_tpu.telemetry.watch.Watchtower`, when alerting
+    is on) appends the ``mxr_alert_state`` family; None appends nothing
+    — byte parity with the watch-less exposition."""
     rank = telemetry.get().rank
-    return prometheus_text({rank: engine_summary(engine)})
+    text = prometheus_text({rank: engine_summary(engine)})
+    if watch is not None:
+        from mx_rcnn_tpu.telemetry.watch import alert_state_lines
+        text += "\n".join(alert_state_lines(watch)) + "\n"
+    return text
 
 
 def pool_summary(pool) -> dict:
@@ -501,12 +537,17 @@ def pool_summary(pool) -> dict:
             "hists": {}}
 
 
-def pool_prometheus(pool) -> str:
+def pool_prometheus(pool, watch=None) -> str:
     """Multi-model ``/metrics?format=prom``: one rank per MODEL ID (each
     model's engine summary renders under ``rank="<model>"``) plus the
     pool's paging/scheduling block under ``rank="pool"`` — per-model
-    families without inventing a second label scheme."""
+    families without inventing a second label scheme.  ``watch``
+    appends ``mxr_alert_state`` exactly as in :func:`serve_prometheus`."""
     per_rank = {mid: engine_summary(pool.engine_for(mid))
                 for mid in pool.model_ids()}
     per_rank["pool"] = pool_summary(pool)
-    return prometheus_text(per_rank)
+    text = prometheus_text(per_rank)
+    if watch is not None:
+        from mx_rcnn_tpu.telemetry.watch import alert_state_lines
+        text += "\n".join(alert_state_lines(watch)) + "\n"
+    return text

@@ -163,16 +163,21 @@ def event_files(paths: Iterable[str]) -> List[str]:
     telemetry/tracectx.py) fold alongside the per-rank files: same JSONL
     schema, ``kind: "span"`` records whose additive trace fields old
     readers ignore — so ``--trace`` output gains per-member hop tracks
-    and the span table counts cross-hop work with zero extra plumbing."""
+    and the span table counts cross-hop work with zero extra plumbing.
+    Watchtower transition logs (``alerts_<member>.jsonl``,
+    telemetry/watch.py) fold the same way: ``kind: "alert"`` records
+    that old readers ignore, new ones render as the alerts table."""
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
             found = sorted(glob.glob(os.path.join(p, "events_rank*.jsonl")))
             found += sorted(glob.glob(os.path.join(p, "spans_*.jsonl")))
+            found += sorted(glob.glob(os.path.join(p, "alerts_*.jsonl")))
             if not found:
                 raise FileNotFoundError(
-                    f"no events_rank*.jsonl or spans_*.jsonl under {p} — "
-                    f"was the run started with --telemetry-dir?")
+                    f"no events_rank*.jsonl, spans_*.jsonl, or "
+                    f"alerts_*.jsonl under {p} — was the run started "
+                    f"with --telemetry-dir?")
             out.extend(found)
         else:
             out.append(p)
@@ -207,6 +212,7 @@ def aggregate(events: Iterable[dict]) -> dict:
     counters: dict = {}
     gauges: dict = {}
     hists: dict = {}
+    alerts: dict = {}
     ranks = set()
     meta: dict = {}
     pipeline: list = []
@@ -245,6 +251,28 @@ def aggregate(events: Iterable[dict]) -> dict:
             if h is None:
                 h = hists[name] = Hist()
             h.observe(float(e["value"]))
+        elif kind == "alert":
+            # watchtower lifecycle transitions (telemetry/watch.py
+            # alerts_<member>.jsonl): per-alertname tallies + the total
+            # time spent firing, cross-member — "what paged, how often,
+            # for how long" off one fold
+            aname = str(e.get("alert", "?"))
+            a = alerts.get(aname)
+            if a is None:
+                a = alerts[aname] = {
+                    "severity": str(e.get("severity", "warning")),
+                    "pending": 0, "firing": 0, "resolved": 0,
+                    "silenced": 0, "firing_s": 0.0, "members": set()}
+            state = str(e.get("state", "?"))
+            if state in ("pending", "firing", "resolved"):
+                a[state] += 1
+            if e.get("silenced"):
+                a["silenced"] += 1
+            fs = e.get("firing_s")
+            if isinstance(fs, (int, float)):
+                a["firing_s"] += float(fs)
+            if e.get("member") is not None:
+                a["members"].add(str(e["member"]))
         elif kind == "meta":
             if name == "run" and not meta:
                 meta = dict(e.get("fields", {}))
@@ -268,6 +296,13 @@ def aggregate(events: Iterable[dict]) -> dict:
         out_extra["eval_pipeline"] = eval_pipeline
     if programs:
         out_extra["programs"] = programs
+    if alerts:
+        # additive key: a stream with no alert records folds to the
+        # exact pre-watchtower summary shape
+        out_extra["alerts"] = {
+            k: {**{f: v for f, v in a.items() if f != "members"},
+                "members": sorted(a["members"])}
+            for k, a in sorted(alerts.items())}
     return {
         "schema": SCHEMA_VERSION,
         "ranks": sorted(ranks),
@@ -465,6 +500,22 @@ def render_table(summary: dict) -> str:
             lines.append(f"{name:<34}{n:>8}{mean * 1e3:>10.3f}"
                          f"{(p50 or 0.0) * 1e3:>10.3f}"
                          f"{(p99 or 0.0) * 1e3:>10.3f}")
+    alerts = summary.get("alerts", {})
+    if alerts:
+        # the watchtower's lifecycle, folded: how often each alert went
+        # pending/firing/resolved and the total firing time — zero-firing
+        # rows still render so "nothing fired" is a visible fact
+        lines.append("")
+        lines.append(f"{'alert':<28}{'severity':<10}{'pending':>8}"
+                     f"{'firing':>8}{'resolved':>9}{'silenced':>9}"
+                     f"{'firing_s':>10}")
+        for name, a in sorted(alerts.items()):
+            lines.append(f"{name:<28}{a.get('severity', '?'):<10}"
+                         f"{a.get('pending', 0):>8}"
+                         f"{a.get('firing', 0):>8}"
+                         f"{a.get('resolved', 0):>9}"
+                         f"{a.get('silenced', 0):>9}"
+                         f"{a.get('firing_s', 0.0):>10.2f}")
     return "\n".join(lines)
 
 

@@ -253,6 +253,9 @@ class NullTelemetry:
     def hist_quantile(self, name, q, window_s=None):
         return None
 
+    def live_hists(self) -> dict:
+        return {}
+
     def dump_flight(self, reason, **fields):
         return None
 
@@ -412,6 +415,13 @@ class Telemetry:
         if window_s is not None:
             return h.window_quantile(q, window_s)
         return h.quantile(q)
+
+    def live_hists(self) -> dict:
+        """Name → live :class:`Hist` (the objects, not copies — Hist is
+        internally locked).  The watchtower's window into every
+        ``observe`` stream for quantile/burn-rate rules."""
+        with self._lock:
+            return dict(self._hists)
 
     def meta(self, name: str, **fields):
         with self._lock:

@@ -224,6 +224,9 @@ class NullTracer:
     def dump_tail(self):
         return None
 
+    def tail_trace_ids(self, since=None, limit=16):
+        return []
+
     def flush(self):
         pass
 
@@ -379,6 +382,30 @@ class Tracer:
                     f.write(json.dumps(rec) + "\n")
         os.replace(tmp, path)
         return path
+
+    def tail_trace_ids(self, since: Optional[float] = None,
+                       limit: int = 16) -> "list[str]":
+        """Unique trace ids from the kept-trees ring, newest first —
+        what a firing alert attaches so the page arrives with the
+        slow-request span trees that explain it.  ``since`` filters to
+        trees whose newest span landed at/after that wall time."""
+        with self._lock:
+            trees = [list(t) for t in self._tail]
+        out: "list[str]" = []
+        seen = set()
+        for tree in reversed(trees):
+            if not tree:
+                continue
+            if since is not None and max(r.get("t", 0)
+                                         for r in tree) < since:
+                continue
+            tid = tree[0].get("trace")
+            if tid and tid not in seen:
+                seen.add(tid)
+                out.append(tid)
+            if len(out) >= limit:
+                break
+        return out
 
     # -- introspection / lifecycle ---------------------------------------
 

@@ -1,0 +1,1115 @@
+"""Watchtower: fleet-wide alerting, error budgets and metric history
+(ISSUE 20).
+
+Four PRs of *emitters* — PR-1 telemetry, PR-5 live /metrics + flight
+recorder, PR-6 latency hists + SLO controller, PR-16 distributed
+tracing — and zero *consumers*: nothing watched the signals, so a
+recompile storm or a p99 burn was only discovered when an operator
+curled /metrics.  :class:`Watchtower` closes the loop.  It is a control
+loop in the PR-6/PR-18 mold: one injectable ``tick(now=None)`` step that
+tests drive with a fake clock and production wraps in a daemon monitor
+thread.
+
+Each tick samples every registered counter/gauge (plus, on the router,
+the folded fleet view) into a bounded, downsampled
+:class:`MetricHistory` ring (raw → 10s → 60s tiers), then evaluates a
+declarative **alert-rule pack** against it.  Four rule kinds, all
+computed from signals that already exist:
+
+- **threshold** — any counter/gauge/hist-quantile vs a bound, as a raw
+  value, a windowed ``rate`` or a windowed ``delta``, with an optional
+  ``guard`` clause (fire only while another series also holds);
+- **burn_rate** — real error-budget semantics: a tick *violates* when
+  the windowed p-quantile (PR-6 hist snapshots) exceeds ``target_ms``
+  AND the histogram advanced (no traffic burns no budget); the rule
+  fires on dual-window burn (``fast_burn``× budget over the fast window
+  and ``slow_burn``× over the slow one — the classic page-worthy
+  fast/slow pair);
+- **absence** — staleness: a fleet member not serving, or a local
+  series that *stopped changing* (armed only after it changed once, so
+  a feature that never ran cannot fire its stall alert; likewise a
+  fleet member arms only once it has been ready — a cold boot still
+  warming up is not a page);
+- **trend** — the PR-6/PR-18 least-squares slope over any series
+  (recompiles must be flat after warmup).
+
+Every rule may set ``scope: "fleet"`` (router only): threshold/trend
+evaluate per member over ``member/<name>/<metric>`` series, absence
+watches membership itself, burn_rate diffs per-member summary
+histograms — each instance labeled ``{"member": ...}``.
+
+Alert lifecycle: ``pending`` →(held ``for_s``)→ ``firing`` →
+``resolved``, deduplicated by a stable fingerprint of (name, labels).
+Every transition is first-class telemetry: an ``alert_transition`` meta
+event, an atomic ``alerts_<member>.jsonl`` record (new JSONL kind
+``alert`` — additive, old readers ignore it), and — the forensic
+payoff — a firing alert dumps the flight ring with the PR-16
+tail-sampled trace ids from the breach window attached, so "p99 alert"
+arrives with the slow-request span trees that explain it.  Silences
+(by alertname, with expiry) mute the noise without losing the record.
+
+Watchtower-off (the default) constructs nothing: no thread, no ring
+growth, /metrics byte-for-byte unchanged — pinned by test, the same
+contract as every prior plane.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from mx_rcnn_tpu import telemetry
+from mx_rcnn_tpu.logger import logger
+from mx_rcnn_tpu.telemetry import tracectx
+from mx_rcnn_tpu.telemetry.sink import quantile_from_counts
+
+
+def _slope(points) -> float:
+    """Least-squares slope of [(t, y)] — the same estimator as the PR-6
+    SLO controller's queue trend, re-stated here because the telemetry
+    layer must stay stdlib-only (importing ``serve.controller`` would
+    pull the whole serve package, jax included, into every watch-less
+    tool that reads alert logs)."""
+    n = len(points)
+    if n < 2:
+        return 0.0
+    t0 = points[0][0]
+    xs = [t - t0 for t, _ in points]
+    ys = [float(y) for _, y in points]
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    denom = sum((x - mx) ** 2 for x in xs)
+    if denom <= 0:
+        return 0.0
+    return sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+
+# the pack shipped with the repo — serve p99 burn, shed rate, steady-
+# state recompile, member staleness, parked-fleet-under-load, flywheel
+# generation stall (see README "Alerting & error budgets")
+DEFAULT_RULES_PATH = os.path.join(os.path.dirname(__file__),
+                                  "rules_default.json")
+
+ALERTS_PREFIX = "alerts_"        # alerts_<member>.jsonl transition log
+TRANSITION_KEEP = 1000           # transitions kept (and rewritten) per log
+
+
+@dataclass(frozen=True)
+class WatchOptions:
+    interval_s: float = 1.0      # monitor tick period
+    raw_keep: int = 256          # raw samples kept per series
+    mid_keep: int = 360          # 10s buckets kept (~1 h)
+    coarse_keep: int = 1440      # 60s buckets kept (~1 day)
+    mid_step_s: float = 10.0     # mid-tier bucket width
+    coarse_step_s: float = 60.0  # coarse-tier bucket width
+    resolved_keep: int = 64      # resolved alerts kept for /alerts + Prom
+    max_series: int = 512        # history ring hard cap (drop + count past)
+
+    def __post_init__(self):
+        if self.interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        if self.raw_keep < 2 or self.mid_keep < 2 or self.coarse_keep < 2:
+            raise ValueError("history tiers need at least 2 slots each")
+        if not 0 < self.mid_step_s < self.coarse_step_s:
+            raise ValueError("need 0 < mid_step_s < coarse_step_s — the "
+                             "tiers downsample, they don't overlap")
+        if self.resolved_keep < 1:
+            raise ValueError("resolved_keep must be >= 1")
+        if self.max_series < 1:
+            raise ValueError("max_series must be >= 1")
+
+
+class _Series:
+    """One metric's history: a raw ring plus two downsampled tiers.
+
+    Each tier accumulates into a current bucket ``{t, last, min, max,
+    count}`` and flushes it to the tier's deque when ``now`` crosses the
+    bucket edge — O(1) per sample, bounded memory, and the merge in
+    :meth:`MetricHistory.series` stitches the tiers into one timeline
+    (raw where it reaches, mid beyond it, coarse beyond that)."""
+
+    __slots__ = ("raw", "mid", "coarse", "mid_cur", "coarse_cur",
+                 "last_value", "last_change_t", "changed_ever")
+
+    def __init__(self, opts: WatchOptions):
+        self.raw: collections.deque = collections.deque(
+            maxlen=opts.raw_keep)
+        self.mid: collections.deque = collections.deque(
+            maxlen=opts.mid_keep)
+        self.coarse: collections.deque = collections.deque(
+            maxlen=opts.coarse_keep)
+        self.mid_cur: Optional[dict] = None
+        self.coarse_cur: Optional[dict] = None
+        self.last_value: Optional[float] = None
+        self.last_change_t: Optional[float] = None
+        self.changed_ever = False
+
+
+def _bucket_add(cur: Optional[dict], ring: collections.deque,
+                step: float, now: float, value: float) -> dict:
+    start = (now // step) * step
+    if cur is None or cur["t"] != start:
+        if cur is not None:
+            ring.append(cur)
+        cur = {"t": start, "last": value, "min": value, "max": value,
+               "count": 0}
+    cur["last"] = value
+    cur["min"] = min(cur["min"], value)
+    cur["max"] = max(cur["max"], value)
+    cur["count"] += 1
+    return cur
+
+
+class MetricHistory:
+    """Bounded in-process history for every registered series.
+
+    Powers rule windows, the ``/history?metric=&window=`` endpoint and
+    ``scripts/alert_query.py`` sparklines.  Thread-safe; at most
+    ``max_series`` series are tracked (extras are dropped and counted —
+    a runaway label cardinality must not eat the server's heap)."""
+
+    def __init__(self, opts: Optional[WatchOptions] = None):
+        self.opts = opts or WatchOptions()
+        self._series: Dict[str, _Series] = {}
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def record(self, name: str, value: float, now: float):
+        value = float(value)
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                if len(self._series) >= self.opts.max_series:
+                    self.dropped += 1
+                    return
+                s = self._series[name] = _Series(self.opts)
+            s.raw.append((now, value))
+            s.mid_cur = _bucket_add(s.mid_cur, s.mid,
+                                    self.opts.mid_step_s, now, value)
+            s.coarse_cur = _bucket_add(s.coarse_cur, s.coarse,
+                                       self.opts.coarse_step_s, now, value)
+            if s.last_value is None:
+                s.last_value, s.last_change_t = value, now
+            elif value != s.last_value:
+                s.last_value, s.last_change_t = value, now
+                s.changed_ever = True
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def value(self, name: str) -> Optional[float]:
+        with self._lock:
+            s = self._series.get(name)
+            return None if s is None else s.last_value
+
+    def last_change_age(self, name: str,
+                        now: float) -> Tuple[Optional[float], bool]:
+        """``(seconds since the series last changed value, has it ever
+        changed)`` — the absence rule's arming pair."""
+        with self._lock:
+            s = self._series.get(name)
+            if s is None or s.last_change_t is None:
+                return None, False
+            return now - s.last_change_t, s.changed_ever
+
+    def series(self, name: str, window_s: float,
+               now: float) -> List[Tuple[float, float]]:
+        """``[(t, value)]`` over the trailing window, stitched across
+        tiers: raw points where the raw ring reaches, mid buckets
+        (``last``) before that, coarse buckets before the mid tier."""
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                return []
+            raw = list(s.raw)
+            mid = list(s.mid) + ([s.mid_cur] if s.mid_cur else [])
+            coarse = list(s.coarse) + ([s.coarse_cur] if s.coarse_cur
+                                       else [])
+        cutoff = now - window_s
+        raw_floor = raw[0][0] if raw else now
+        mid_floor = mid[0]["t"] if mid else raw_floor
+        pts = [(b["t"], b["last"]) for b in coarse
+               if b["t"] < mid_floor]
+        pts += [(b["t"], b["last"]) for b in mid if b["t"] < raw_floor]
+        pts += raw
+        return [(t, v) for t, v in pts if t >= cutoff]
+
+    def mean(self, name: str, window_s: float, now: float,
+             default: float = 0.0) -> float:
+        pts = self.series(name, window_s, now)
+        if not pts:
+            return default
+        return sum(v for _, v in pts) / len(pts)
+
+    def to_doc(self, name: str, window_s: float, now: float) -> dict:
+        pts = self.series(name, window_s, now)
+        doc = {"metric": name, "window_s": window_s,
+               "points": [[round(t, 3), v] for t, v in pts]}
+        if pts:
+            vals = [v for _, v in pts]
+            doc.update(last=vals[-1], min=min(vals), max=max(vals),
+                       mean=sum(vals) / len(vals))
+        return doc
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"series": len(self._series), "dropped": self.dropped}
+
+
+# -- rule pack -----------------------------------------------------------
+
+class RuleError(ValueError):
+    """An invalid alert rule — the message names the offending rule."""
+
+
+_KINDS = ("threshold", "burn_rate", "absence", "trend")
+_COMMON_KEYS = {"name", "kind", "severity", "for_s", "labels", "scope"}
+_KIND_KEYS = {
+    "threshold": {"metric", "op", "value", "mode", "window_s", "guard"},
+    "burn_rate": {"metric", "quantile", "target_ms", "budget",
+                  "fast_window_s", "slow_window_s", "fast_burn",
+                  "slow_burn"},
+    "absence": {"metric", "value"},
+    "trend": {"metric", "window_s", "slope_gt", "warmup_s", "min_points"},
+}
+
+
+def _num(rule_id, raw, key, default=None, required=False, gt=None,
+         ge=None, lt=None, le=None):
+    v = raw.get(key, default)
+    if v is None:
+        if required:
+            raise RuleError(f"{rule_id}: missing required key {key!r}")
+        return None
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise RuleError(f"{rule_id}: {key} must be a number, got {v!r}")
+    v = float(v)
+    if gt is not None and not v > gt:
+        raise RuleError(f"{rule_id}: {key} must be > {gt}, got {v}")
+    if ge is not None and not v >= ge:
+        raise RuleError(f"{rule_id}: {key} must be >= {ge}, got {v}")
+    if lt is not None and not v < lt:
+        raise RuleError(f"{rule_id}: {key} must be < {lt}, got {v}")
+    if le is not None and not v <= le:
+        raise RuleError(f"{rule_id}: {key} must be <= {le}, got {v}")
+    return v
+
+
+def _check_guard(rule_id, guard):
+    if not isinstance(guard, dict):
+        raise RuleError(f"{rule_id}: guard must be an object")
+    extra = set(guard) - {"metric", "op", "value"}
+    if extra:
+        raise RuleError(f"{rule_id}: guard has unknown keys "
+                        f"{sorted(extra)}")
+    if not isinstance(guard.get("metric"), str) or not guard["metric"]:
+        raise RuleError(f"{rule_id}: guard.metric must be a non-empty "
+                        "string")
+    if guard.get("op", ">") not in (">", "<"):
+        raise RuleError(f"{rule_id}: guard.op must be '>' or '<'")
+    _num(rule_id, guard, "value", required=True)
+    return {"metric": guard["metric"], "op": guard.get("op", ">"),
+            "value": float(guard["value"])}
+
+
+def validate_rules(doc) -> List[dict]:
+    """Validate + normalize a rule pack (``{"version": 1, "rules":
+    [...]}`` or a bare list).  Raises :class:`RuleError` naming the
+    offending rule; returns rules with every default filled in."""
+    if isinstance(doc, dict):
+        if doc.get("version", 1) != 1:
+            raise RuleError(f"unsupported rule pack version "
+                            f"{doc.get('version')!r} (expected 1)")
+        rules = doc.get("rules")
+    else:
+        rules = doc
+    if not isinstance(rules, list):
+        raise RuleError("rule pack must be a list of rules or "
+                        '{"version": 1, "rules": [...]}')
+    out: List[dict] = []
+    seen = set()
+    for i, raw in enumerate(rules):
+        rule_id = f"rule {i}"
+        if not isinstance(raw, dict):
+            raise RuleError(f"{rule_id}: must be an object, got "
+                            f"{type(raw).__name__}")
+        name = raw.get("name")
+        if not isinstance(name, str) or not name:
+            raise RuleError(f"{rule_id}: missing required key 'name'")
+        rule_id = f"rule {i} ({name!r})"
+        if name in seen:
+            raise RuleError(f"{rule_id}: duplicate rule name")
+        seen.add(name)
+        kind = raw.get("kind")
+        if kind not in _KINDS:
+            raise RuleError(f"{rule_id}: kind must be one of "
+                            f"{list(_KINDS)}, got {kind!r}")
+        extra = set(raw) - _COMMON_KEYS - _KIND_KEYS[kind]
+        if extra:
+            raise RuleError(f"{rule_id}: unknown keys {sorted(extra)} "
+                            f"for kind {kind!r}")
+        labels = raw.get("labels", {})
+        if not isinstance(labels, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in labels.items()):
+            raise RuleError(f"{rule_id}: labels must map strings to "
+                            "strings")
+        scope = raw.get("scope", "local")
+        if scope not in ("local", "fleet"):
+            raise RuleError(f"{rule_id}: scope must be 'local' or "
+                            f"'fleet', got {scope!r}")
+        rule = {"name": name, "kind": kind,
+                "severity": str(raw.get("severity", "warning")),
+                "for_s": _num(rule_id, raw, "for_s", default=0.0, ge=0.0),
+                "labels": dict(labels), "scope": scope}
+        metric = raw.get("metric")
+        if not isinstance(metric, str) or not metric:
+            raise RuleError(f"{rule_id}: missing required key 'metric'")
+        rule["metric"] = metric
+        if kind == "threshold":
+            op = raw.get("op")
+            if op not in (">", "<"):
+                raise RuleError(f"{rule_id}: op must be '>' or '<', "
+                                f"got {op!r}")
+            rule["op"] = op
+            rule["value"] = _num(rule_id, raw, "value", required=True)
+            mode = raw.get("mode", "value")
+            if mode not in ("value", "rate", "delta"):
+                raise RuleError(f"{rule_id}: mode must be 'value', "
+                                f"'rate' or 'delta', got {mode!r}")
+            rule["mode"] = mode
+            rule["window_s"] = _num(rule_id, raw, "window_s",
+                                    default=60.0, gt=0.0)
+            rule["guard"] = (_check_guard(rule_id, raw["guard"])
+                            if raw.get("guard") is not None else None)
+        elif kind == "burn_rate":
+            rule["quantile"] = _num(rule_id, raw, "quantile",
+                                    default=0.99, gt=0.0, lt=1.0)
+            rule["target_ms"] = _num(rule_id, raw, "target_ms",
+                                     required=True, gt=0.0)
+            rule["budget"] = _num(rule_id, raw, "budget", default=0.05,
+                                  gt=0.0, le=1.0)
+            rule["fast_window_s"] = _num(rule_id, raw, "fast_window_s",
+                                         default=60.0, gt=0.0)
+            rule["slow_window_s"] = _num(rule_id, raw, "slow_window_s",
+                                         default=300.0, gt=0.0)
+            if rule["slow_window_s"] < rule["fast_window_s"]:
+                raise RuleError(f"{rule_id}: slow_window_s must be >= "
+                                "fast_window_s — the slow window is the "
+                                "sustained check")
+            rule["fast_burn"] = _num(rule_id, raw, "fast_burn",
+                                     default=6.0, gt=0.0)
+            rule["slow_burn"] = _num(rule_id, raw, "slow_burn",
+                                     default=2.0, gt=0.0)
+        elif kind == "absence":
+            rule["value"] = _num(rule_id, raw, "value", required=True,
+                                 gt=0.0)
+        else:  # trend
+            rule["window_s"] = _num(rule_id, raw, "window_s",
+                                    default=120.0, gt=0.0)
+            rule["slope_gt"] = _num(rule_id, raw, "slope_gt",
+                                    required=True)
+            rule["warmup_s"] = _num(rule_id, raw, "warmup_s",
+                                    default=0.0, ge=0.0)
+            rule["min_points"] = int(_num(rule_id, raw, "min_points",
+                                          default=3, ge=2))
+        out.append(rule)
+    return out
+
+
+def load_rules(path: str) -> List[dict]:
+    """Load + validate a rule pack file (``--alert-rules``)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise RuleError(f"alert rules {path}: {e}") from e
+    try:
+        return validate_rules(doc)
+    except RuleError as e:
+        raise RuleError(f"alert rules {path}: {e}") from e
+
+
+def default_rules() -> List[dict]:
+    return load_rules(DEFAULT_RULES_PATH)
+
+
+def fingerprint(name: str, labels: Dict[str, str]) -> str:
+    """Stable dedup key for one alert instance: same (rule, labels) →
+    same fingerprint across fire/resolve/refire cycles and processes."""
+    blob = name + "|" + "|".join(f"{k}={v}"
+                                 for k, v in sorted(labels.items()))
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def fleet_from_pool(pool, now: Optional[float] = None) -> dict:
+    """The router's fleet view for rule evaluation, normalized from
+    :meth:`ReplicaPool.metrics` — per-member serving state plus the
+    fleet aggregates the default pack watches (``fleet/demand``,
+    ``fleet/parked``, ``fleet/generation``)."""
+    now = time.monotonic() if now is None else now
+    doc = pool.metrics(now=now)
+    parked_addrs = set(pool.parked_members())
+    members = {}
+    for name, m in doc["members"].items():
+        members[name] = {
+            "state": m["state"],
+            "ready": bool(m["routable"]),
+            "parked": m["address"] in parked_addrs,
+            "age_s": m["queue_depth_age_s"],
+            "queue_depth": float(m["queue_depth"] or 0),
+            "inflight": float(m["inflight"]),
+            "generation": float(m["generation"]),
+        }
+    return {"members": members,
+            "fleet/members": float(len(members)),
+            "fleet/ready": float(doc["ready"]),
+            "fleet/parked": float(len(parked_addrs)),
+            "fleet/demand": float(pool.demand(now)),
+            "fleet/generation": float(doc["generation"])}
+
+
+# -- the watchtower ------------------------------------------------------
+
+class Watchtower:
+    """The alerting control loop over one process's telemetry (and, on
+    the router, the folded fleet).
+
+    ``tick(now=None)`` is one evaluation step and returns the list of
+    transition records it emitted (empty on a quiet tick) so tests can
+    assert the lifecycle without threads.  ``start()`` wraps it in the
+    standard daemon monitor; ``stop()`` joins it.
+
+    Providers are injectable (the deterministic-test surface):
+    ``summary_fn`` → a :meth:`Telemetry.summary`-shaped dict sampled
+    into history each tick; ``hists_fn`` → live :class:`Hist` objects
+    for burn/quantile rules; ``fleet_fn`` → a :func:`fleet_from_pool`
+    doc (router only); ``summaries_fn`` → per-member summary dicts for
+    fleet-scoped burn rules."""
+
+    def __init__(self, rules: Optional[List[dict]] = None,
+                 opts: Optional[WatchOptions] = None,
+                 member: str = "rank0", out_dir: Optional[str] = None,
+                 summary_fn: Optional[Callable[[], dict]] = None,
+                 hists_fn: Optional[Callable[[], dict]] = None,
+                 fleet_fn: Optional[Callable[[], dict]] = None,
+                 summaries_fn: Optional[Callable[[], dict]] = None):
+        self.opts = opts or WatchOptions()
+        self.rules = validate_rules(rules if rules is not None
+                                    else default_rules())
+        self.member = str(member)
+        self.out_dir = out_dir
+        self._summary_fn = summary_fn
+        self._hists_fn = hists_fn
+        self._fleet_fn = fleet_fn
+        self._summaries_fn = summaries_fn
+        self.history = MetricHistory(self.opts)
+        self._lock = threading.Lock()
+        self._instances: Dict[str, dict] = {}   # fingerprint → instance
+        self._resolved: collections.deque = collections.deque(
+            maxlen=self.opts.resolved_keep)
+        self._transitions: collections.deque = collections.deque(
+            maxlen=TRANSITION_KEEP)
+        self._silences: List[dict] = []
+        self._silence_seq = 0
+        self._burn_count: Dict[str, float] = {}  # hist-count watermarks
+        self._hist_snaps: Dict[str, collections.deque] = {}
+        self._last_fleet: Optional[dict] = None
+        self._armed_members: set = set()        # fleet members seen ready
+        self._last_summaries: Dict[str, dict] = {}
+        self._first_tick_t: Optional[float] = None
+        self._last_firing_gauge: Optional[int] = None
+        self.ticks = 0
+        self.counters = {"ticks": 0, "evals": 0, "rule_errors": 0,
+                         "transitions": 0, "fired": 0, "resolved": 0,
+                         "silenced": 0}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.out_dir:
+            os.makedirs(self.out_dir, exist_ok=True)
+
+    def count(self, key: str, inc: int = 1):
+        """Watch counter + the matching ``watch/*`` telemetry counter —
+        one source for ``state()`` and the report table."""
+        self.counters[key] = self.counters.get(key, 0) + inc
+        telemetry.get().counter(f"watch/{key}", inc)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Watchtower":
+        assert self._thread is None, "watchtower already started"
+
+        def monitor():
+            while not self._stop.wait(self.opts.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — alerting must survive
+                    logger.exception("watchtower tick failed")
+
+        self._thread = threading.Thread(target=monitor, name="watchtower",
+                                        daemon=True)
+        self._thread.start()
+        logger.info("watchtower: up — %d rule(s), tick %.1fs, member %s",
+                    len(self.rules), self.opts.interval_s, self.member)
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    # -- sampling --------------------------------------------------------
+
+    def _hists(self) -> dict:
+        if self._hists_fn is not None:
+            try:
+                return self._hists_fn() or {}
+            except Exception:  # noqa: BLE001 — a dying engine is not news
+                logger.exception("watchtower: hists provider failed")
+                return {}
+        tel = telemetry.get()
+        return tel.live_hists() if tel.enabled else {}
+
+    def _sample(self, now: float):
+        """One history sample: every counter (raw value) and gauge
+        (last) in the summary, plus the fleet aggregates and per-member
+        series on the router."""
+        summary = None
+        try:
+            if self._summary_fn is not None:
+                summary = self._summary_fn()
+            elif telemetry.get().enabled:
+                summary = telemetry.get().summary()
+        except Exception:  # noqa: BLE001
+            logger.exception("watchtower: summary provider failed")
+        if isinstance(summary, dict):
+            for k, v in (summary.get("counters") or {}).items():
+                self.history.record(k, v, now)
+            for k, g in (summary.get("gauges") or {}).items():
+                last = g.get("last") if isinstance(g, dict) else g
+                if last is not None:
+                    self.history.record(k, last, now)
+        self._last_fleet = None
+        if self._fleet_fn is not None:
+            try:
+                self._last_fleet = self._fleet_fn()
+            except Exception:  # noqa: BLE001
+                logger.exception("watchtower: fleet provider failed")
+        if self._last_fleet:
+            for k, v in self._last_fleet.items():
+                if k == "members":
+                    continue
+                self.history.record(k, v, now)
+            for m, info in self._last_fleet["members"].items():
+                for k in ("queue_depth", "inflight", "generation"):
+                    if info.get(k) is not None:
+                        self.history.record(f"member/{m}/{k}",
+                                            info[k], now)
+        self._last_summaries = {}
+        if self._summaries_fn is not None:
+            try:
+                self._last_summaries = self._summaries_fn() or {}
+            except Exception:  # noqa: BLE001
+                logger.exception("watchtower: summaries provider failed")
+
+    # -- the evaluation step ---------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """One evaluation: sample → evaluate every rule → fold the
+        lifecycle → emit telemetry.  Returns the transition records."""
+        now = time.monotonic() if now is None else now
+        self.ticks += 1
+        self.counters["ticks"] += 1
+        if self._first_tick_t is None:
+            self._first_tick_t = now
+        self._sample(now)
+        self._prune_silences(now)
+        transitions: List[dict] = []
+        for rule in self.rules:
+            try:
+                for labels, active, value in self._eval(rule, now):
+                    self.counters["evals"] += 1
+                    self._fold(rule, labels, active, value, now,
+                               transitions)
+            except Exception:  # noqa: BLE001 — one bad rule must not
+                logger.exception("watchtower: rule %s failed",
+                                 rule["name"])  # silence the others
+                self.counters["rule_errors"] += 1
+        firing = sum(1 for i in self._instances.values()
+                     if i["state"] == "firing")
+        if firing != self._last_firing_gauge:
+            telemetry.get().gauge("watch/firing", firing)
+            self._last_firing_gauge = firing
+        return transitions
+
+    def _eval(self, rule: dict, now: float):
+        kind = rule["kind"]
+        if kind == "threshold":
+            return self._eval_threshold(rule, now)
+        if kind == "burn_rate":
+            return self._eval_burn(rule, now)
+        if kind == "absence":
+            return self._eval_absence(rule, now)
+        return self._eval_trend(rule, now)
+
+    # threshold ----------------------------------------------------------
+
+    @staticmethod
+    def _cmp(v: float, op: str, bound: float) -> bool:
+        return v > bound if op == ">" else v < bound
+
+    def _series_value(self, name: str, rule: dict,
+                      now: float) -> Optional[float]:
+        """The threshold operand: a hist quantile (``metric@p99``, in
+        ms), the series' last value, or a windowed rate/delta."""
+        if "@p" in name:
+            base, _, digits = name.rpartition("@p")
+            if digits.isdigit():
+                h = self._hists().get(base)
+                if h is None:
+                    return None
+                q = float(digits) / (10 ** len(digits))
+                qv = h.window_quantile(q, rule["window_s"], now=now)
+                if qv is None:
+                    return None
+                ms = qv * 1000.0
+                self.history.record(name, ms, now)  # sparkline source
+                return ms
+        if rule["mode"] == "value":
+            return self.history.value(name)
+        pts = self.history.series(name, rule["window_s"], now)
+        if len(pts) < 2:
+            return None
+        delta = pts[-1][1] - pts[0][1]
+        if rule["mode"] == "delta":
+            return delta
+        span = pts[-1][0] - pts[0][0]
+        return delta / span if span > 0 else None
+
+    def _guard_holds(self, rule: dict) -> bool:
+        g = rule.get("guard")
+        if g is None:
+            return True
+        v = self.history.value(g["metric"])
+        return v is not None and self._cmp(v, g["op"], g["value"])
+
+    def _eval_threshold(self, rule: dict, now: float):
+        targets = [(rule["metric"], {})]
+        if rule["scope"] == "fleet" and self._last_fleet:
+            targets = [(f"member/{m}/{rule['metric']}", {"member": m})
+                       for m in sorted(self._last_fleet["members"])]
+        out = []
+        guard_ok = self._guard_holds(rule)
+        for series, labels in targets:
+            v = self._series_value(series, rule, now)
+            active = (v is not None and guard_ok
+                      and self._cmp(v, rule["op"], rule["value"]))
+            out.append((labels, active, v))
+        return out
+
+    # burn rate ----------------------------------------------------------
+
+    def _violation_bit(self, key: str, rule: dict, now: float,
+                       qv: Optional[float], advanced: bool) -> float:
+        """One tick's budget spend: 1 when the windowed quantile broke
+        target while the histogram advanced (no traffic → no burn —
+        windowed quantiles never decay to None on an idle hist, so the
+        advance gate is what lets a fired burn alert resolve)."""
+        bit = 1.0 if (advanced and qv is not None
+                      and qv * 1000.0 > rule["target_ms"]) else 0.0
+        self.history.record(key, bit, now)
+        return bit
+
+    def _burn_state(self, key: str, rule: dict,
+                    now: float) -> Tuple[bool, float]:
+        fast = self.history.mean(key, rule["fast_window_s"], now)
+        slow = self.history.mean(key, rule["slow_window_s"], now)
+        burn_fast = fast / rule["budget"]
+        burn_slow = slow / rule["budget"]
+        active = (burn_fast >= rule["fast_burn"]
+                  and burn_slow >= rule["slow_burn"])
+        return active, round(burn_fast, 4)
+
+    def _eval_burn(self, rule: dict, now: float):
+        out = []
+        if rule["scope"] == "fleet":
+            for m in sorted(self._last_summaries):
+                d = ((self._last_summaries[m] or {}).get("hists") or
+                     {}).get(rule["metric"])
+                key = f"alert/{rule['name']}/{m}/violation"
+                qv, advanced = self._summary_quantile(key, rule, d, now)
+                self._violation_bit(key, rule, now, qv, advanced)
+                active, burn = self._burn_state(key, rule, now)
+                out.append(({"member": m}, active, burn))
+            return out
+        h = self._hists().get(rule["metric"])
+        key = f"alert/{rule['name']}/violation"
+        cnt = float(h.count) if h is not None else 0.0
+        advanced = cnt > self._burn_count.get(key, 0.0)
+        self._burn_count[key] = cnt
+        qv = (h.window_quantile(rule["quantile"], rule["fast_window_s"],
+                                now=now) if h is not None else None)
+        self._violation_bit(key, rule, now, qv, advanced)
+        active, burn = self._burn_state(key, rule, now)
+        out.append(({}, active, burn))
+        return out
+
+    def _summary_quantile(self, key: str, rule: dict, d: Optional[dict],
+                          now: float) -> Tuple[Optional[float], bool]:
+        """Fleet burn operand: the windowed quantile of one member's
+        summary histogram, from the delta between the current dict and
+        the retained snapshot at the fast-window edge."""
+        snaps = self._hist_snaps.setdefault(
+            key, collections.deque(maxlen=512))
+        if not isinstance(d, dict) or "buckets" not in d:
+            return None, False
+        count = int(d.get("count", 0))
+        buckets = [int(c) for c in d["buckets"]]
+        le = d.get("le") or []
+        prev_count = snaps[-1][1] if snaps else 0
+        base = None
+        cutoff = now - rule["fast_window_s"]
+        for t, c, b in reversed(snaps):
+            if t <= cutoff:
+                base = (c, b)
+                break
+        snaps.append((now, count, tuple(buckets)))
+        advanced = count > prev_count
+        if base is None:
+            n, counts = count, buckets
+        else:
+            n = count - base[0]
+            counts = [max(x - y, 0)
+                      for x, y in zip(buckets, base[1])]
+        if n <= 0:
+            return None, advanced
+        return quantile_from_counts(le, counts, n, rule["quantile"]), \
+            advanced
+
+    # absence ------------------------------------------------------------
+
+    def _eval_absence(self, rule: dict, now: float):
+        if rule["scope"] == "fleet":
+            out = []
+            members = (self._last_fleet or {}).get("members") or {}
+            for m in sorted(members):
+                info = members[m]
+                if info.get("parked"):
+                    # a parked member is intentionally idle spare
+                    # capacity, not a stale member
+                    continue
+                if info.get("ready"):
+                    self._armed_members.add(m)
+                if m not in self._armed_members:
+                    # never-yet-ready: a cold boot still warming up is a
+                    # scale-up in progress, not a stale member — the
+                    # fleet mirror of the local arming gate below; it
+                    # arms the first time it serves (and stays armed
+                    # across kill/evict/rejoin under the same name)
+                    continue
+                age = info.get("age_s")
+                stale = (not info.get("ready")) or (
+                    age is not None and age > rule["value"])
+                out.append(({"member": m}, stale, age))
+            return out
+        age, changed = self.history.last_change_age(rule["metric"], now)
+        active = bool(changed) and age is not None \
+            and age > rule["value"]
+        return [({}, active, age)]
+
+    # trend --------------------------------------------------------------
+
+    def _eval_trend(self, rule: dict, now: float):
+        warm = (self._first_tick_t is not None
+                and now - self._first_tick_t >= rule["warmup_s"])
+        targets = [(rule["metric"], {})]
+        if rule["scope"] == "fleet" and self._last_fleet:
+            targets = [(f"member/{m}/{rule['metric']}", {"member": m})
+                       for m in sorted(self._last_fleet["members"])]
+        out = []
+        for series, labels in targets:
+            pts = self.history.series(series, rule["window_s"], now)
+            if not warm or len(pts) < rule["min_points"]:
+                out.append((labels, False, None))
+                continue
+            slope = _slope(pts)
+            out.append((labels, slope > rule["slope_gt"],
+                        round(slope, 6)))
+        return out
+
+    # -- lifecycle fold ---------------------------------------------------
+
+    def _fold(self, rule: dict, labels: Dict[str, str], active: bool,
+              value, now: float, transitions: List[dict]):
+        all_labels = dict(rule["labels"], **labels)
+        fp = fingerprint(rule["name"], all_labels)
+        with self._lock:
+            inst = self._instances.get(fp)
+        if active:
+            if inst is None:
+                inst = {"rule": rule, "alert": rule["name"],
+                        "severity": rule["severity"],
+                        "labels": all_labels, "fingerprint": fp,
+                        "state": "pending", "since": now,
+                        "fired_at": None, "value": value,
+                        "trace_ids": []}
+                with self._lock:
+                    self._instances[fp] = inst
+                transitions.append(
+                    self._transition(inst, "pending", now))
+            inst["value"] = value
+            if inst["state"] == "pending" \
+                    and now - inst["since"] >= rule["for_s"]:
+                inst["state"] = "firing"
+                inst["fired_at"] = now
+                inst["trace_ids"] = self._breach_traces()
+                self.count("fired")
+                rec = self._transition(
+                    inst, "firing", now,
+                    held_s=round(now - inst["since"], 3),
+                    trace_ids=inst["trace_ids"])
+                transitions.append(rec)
+                if not rec.get("silenced"):
+                    telemetry.get().dump_flight(
+                        "alert_firing", alert=inst["alert"],
+                        severity=inst["severity"],
+                        fingerprint=fp, labels=all_labels,
+                        value=value, trace_ids=inst["trace_ids"])
+                    logger.warning(
+                        "ALERT firing: %s (%s) %s value=%s",
+                        inst["alert"], inst["severity"], all_labels,
+                        value)
+        elif inst is not None:
+            with self._lock:
+                self._instances.pop(fp, None)
+            if inst["state"] == "firing":
+                firing_s = round(now - inst["fired_at"], 3)
+                self.count("resolved")
+                transitions.append(
+                    self._transition(inst, "resolved", now,
+                                     firing_s=firing_s))
+                with self._lock:
+                    self._resolved.append(
+                        {"alert": inst["alert"],
+                         "severity": inst["severity"],
+                         "labels": inst["labels"], "fingerprint": fp,
+                         "resolved_at": now, "firing_s": firing_s})
+                logger.info("ALERT resolved: %s %s after %.1fs",
+                            inst["alert"], all_labels, firing_s)
+            # a pending that clears before the hold is not an incident:
+            # no resolved record, the pending record stands alone
+
+    def _breach_traces(self) -> List[str]:
+        tracer = tracectx.get()
+        if not getattr(tracer, "enabled", False):
+            return []
+        try:
+            return tracer.tail_trace_ids()
+        except Exception:  # noqa: BLE001 — forensics are best-effort
+            return []
+
+    def _transition(self, inst: dict, state: str, now: float,
+                    **extra) -> dict:
+        rec = {"v": 1, "t": time.time(), "kind": "alert",
+               "member": self.member, "alert": inst["alert"],
+               "severity": inst["severity"], "state": state,
+               "fingerprint": inst["fingerprint"],
+               "labels": inst["labels"], "value": inst["value"]}
+        rec.update(extra)
+        if self._is_silenced(inst["alert"], now):
+            rec["silenced"] = True
+            if state == "firing":
+                self.count("silenced")
+        self.count("transitions")
+        with self._lock:
+            self._transitions.append(rec)
+        self._write_log()
+        telemetry.get().meta(
+            "alert_transition", alert=rec["alert"], state=state,
+            severity=rec["severity"], fingerprint=rec["fingerprint"],
+            labels=rec["labels"], value=rec["value"])
+        return rec
+
+    def _write_log(self):
+        """Atomic rewrite of the bounded transition log — transitions
+        are rare, and a reader never sees a torn line."""
+        if not self.out_dir:
+            return
+        path = os.path.join(self.out_dir,
+                            f"{ALERTS_PREFIX}{self.member}.jsonl")
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with self._lock:
+            recs = list(self._transitions)
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for rec in recs:
+                    f.write(json.dumps(rec) + "\n")
+            os.replace(tmp, path)
+        except OSError:
+            logger.exception("watchtower: alert log write failed")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    # -- silences --------------------------------------------------------
+
+    def silence(self, alertname: str, duration_s: float,
+                now: Optional[float] = None) -> int:
+        """Mute one alertname for ``duration_s`` seconds.  A silenced
+        alert still runs its full lifecycle and still logs transitions
+        (marked ``silenced``) — it is excluded from the firing list,
+        the Prometheus family and the flight dump, not from history."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._silence_seq += 1
+            sid = self._silence_seq
+            self._silences.append({"id": sid, "alertname": str(alertname),
+                                   "until": now + float(duration_s)})
+        logger.info("watchtower: silence #%d on %s for %.0fs", sid,
+                    alertname, duration_s)
+        return sid
+
+    def unsilence(self, sid: int) -> bool:
+        with self._lock:
+            before = len(self._silences)
+            self._silences = [s for s in self._silences
+                              if s["id"] != sid]
+            return len(self._silences) < before
+
+    def _prune_silences(self, now: float):
+        with self._lock:
+            self._silences = [s for s in self._silences
+                              if s["until"] > now]
+
+    def _is_silenced(self, alertname: str, now: float) -> bool:
+        with self._lock:
+            return any(s["alertname"] == alertname and s["until"] > now
+                       for s in self._silences)
+
+    # -- introspection ---------------------------------------------------
+
+    def _instance_doc(self, inst: dict, now: float) -> dict:
+        doc = {"alert": inst["alert"], "severity": inst["severity"],
+               "labels": inst["labels"],
+               "fingerprint": inst["fingerprint"],
+               "state": inst["state"],
+               "since_s": round(now - inst["since"], 3),
+               "value": inst["value"]}
+        if inst["state"] == "firing":
+            doc["trace_ids"] = list(inst["trace_ids"])
+        return doc
+
+    def firing(self, now: Optional[float] = None) -> List[dict]:
+        """Currently-firing, unsilenced alert instances."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            insts = list(self._instances.values())
+        return [self._instance_doc(i, now) for i in insts
+                if i["state"] == "firing"
+                and not self._is_silenced(i["alert"], now)]
+
+    def alerts_doc(self, now: Optional[float] = None) -> dict:
+        """The ``/alerts`` endpoint document."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            insts = list(self._instances.values())
+            resolved = list(self._resolved)
+            silences = [dict(s) for s in self._silences]
+        firing, pending, silenced = [], [], []
+        for i in insts:
+            doc = self._instance_doc(i, now)
+            if self._is_silenced(i["alert"], now):
+                silenced.append(doc)
+            elif i["state"] == "firing":
+                firing.append(doc)
+            else:
+                pending.append(doc)
+        for s in silences:
+            s["expires_in_s"] = round(s.pop("until") - now, 3)
+        return {"v": 1, "member": self.member, "ticks": self.ticks,
+                "rules": len(self.rules), "firing": firing,
+                "pending": pending, "silenced": silenced,
+                "resolved": [dict(r, age_s=round(now - r["resolved_at"],
+                                                 3))
+                             for r in resolved],
+                "silences": silences,
+                "counters": dict(self.counters)}
+
+    def history_doc(self, metric: str, window_s: float = 300.0,
+                    now: Optional[float] = None) -> dict:
+        """The ``/history?metric=&window=`` endpoint document."""
+        now = time.monotonic() if now is None else now
+        return self.history.to_doc(metric, window_s, now)
+
+    def state(self) -> dict:
+        """JSON-able watch state for the ``/metrics`` pane."""
+        with self._lock:
+            firing = sum(1 for i in self._instances.values()
+                         if i["state"] == "firing")
+            pending = sum(1 for i in self._instances.values()
+                          if i["state"] == "pending")
+            silences = len(self._silences)
+        return {"rules": len(self.rules), "ticks": self.ticks,
+                "firing": firing, "pending": pending,
+                "silences": silences,
+                "history": self.history.stats(),
+                "counters": dict(self.counters)}
+
+
+# -- Prometheus exposition ----------------------------------------------
+
+def _esc(s: str) -> str:
+    return (str(s).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def alert_state_lines(watch: Optional[Watchtower],
+                      now: Optional[float] = None) -> List[str]:
+    """The ``mxr_alert_state`` family: 1 firing, 0.5 pending, 0 for the
+    retained resolved set — appended to the serve/fabric Prometheus
+    text the same way ``fabric_member_count`` is.  Empty (not an empty
+    family) when the watchtower is off: byte parity."""
+    if watch is None:
+        return []
+    now = time.monotonic() if now is None else now
+    lines = ["# HELP mxr_alert_state Alert lifecycle state "
+             "(1=firing, 0.5=pending, 0=recently resolved).",
+             "# TYPE mxr_alert_state gauge"]
+    with watch._lock:
+        insts = list(watch._instances.values())
+        resolved = list(watch._resolved)
+    live = set()
+
+    def label_str(alert, severity, labels):
+        parts = [f'alertname="{_esc(alert)}"',
+                 f'severity="{_esc(severity)}"',
+                 f'member="{_esc(labels.get("member", watch.member))}"']
+        parts += [f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+                  if k != "member"]
+        return "{" + ",".join(parts) + "}"
+
+    for i in insts:
+        if watch._is_silenced(i["alert"], now):
+            continue
+        live.add(i["fingerprint"])
+        v = "1" if i["state"] == "firing" else "0.5"
+        lines.append("mxr_alert_state"
+                     + label_str(i["alert"], i["severity"], i["labels"])
+                     + f" {v}")
+    seen = set()
+    for r in reversed(resolved):
+        if r["fingerprint"] in live or r["fingerprint"] in seen:
+            continue
+        seen.add(r["fingerprint"])
+        lines.append("mxr_alert_state"
+                     + label_str(r["alert"], r["severity"], r["labels"])
+                     + " 0")
+    return lines

@@ -1,0 +1,283 @@
+#!/usr/bin/env bash
+# Watchtower smoke (CPU-friendly): the ISSUE-20 alerting plane over a
+# real localhost-TCP fabric — one router running --watch with the
+# DEFAULT rule pack (+ --trace, so pages carry forensics) and TWO
+# standalone members that self-register with --join, sharing one AOT
+# program cache so only the first boot compiles.
+#
+#   0. A bad rule pack must be a clean boot error naming the offending
+#      rule — alerting that half-loads is worse than none.
+#   1. Clean pass — fleet warms (a cold boot must NOT page member_stale:
+#      a member arms only once it has been ready), loadgen drives clean
+#      traffic, and --watch-check asserts NOTHING ever fired.  The live
+#      /alerts and /history endpoints answer (alert_query.py --live /
+#      --history renders them).
+#   2. SLO burn — both members restart with an injected 8s /predict
+#      delay (MXR_FAULT_NET_DELAY_MS: response-path only, so probes
+#      stay healthy and the fleet looks "up" while every request
+#      breaches the 2500ms p99 target).  The crash-restart itself must
+#      fire-and-resolve member_stale; the delayed traffic must burn the
+#      error budget until fabric_p99_burn pages — loadgen's
+#      --watch-expect pins both arcs, and the Prometheus exposition
+#      must show mxr_alert_state{alertname="fabric_p99_burn"...} 1
+#      while the page is live.
+#   3. Recovery — traffic stops, so the budget stops burning (no
+#      traffic burns no budget) and the alert must RESOLVE on its own;
+#      alert_query.py asserts the full pending→firing→resolved arc AND
+#      that the firing transition carried tail-sampled trace ids (the
+#      alert→trace join the flight dump relies on).
+#
+# The run lands as an mxr_watch_report (WATCH_r01.json) scored by
+# scripts/perf_gate.py: clean_fired/firing_at_end/rule_errors against
+# ZERO ceilings, fault_fired/fault_resolved/fault_trace_ids against
+# floors of 1.
+#
+#   bash script/watch_smoke.sh
+set -e
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+dir=${WATCH_SMOKE_DIR:-/tmp/mxr_watch_smoke}
+rm -rf "$dir"
+mkdir -p "$dir"
+cache="$dir/program_cache"   # shared AOT warm-start: 4 boots, 1 compile
+tel="$dir/tel"
+
+common=(--network resnet50 --synthetic --serve-batch 2 --max-delay-ms 20
+        --max-queue 32 --deadline-ms 120000 --program-cache "$cache"
+        --cfg "tpu__SCALES=((96,128),)" --cfg "network__ANCHOR_SCALES=(2,4)"
+        --cfg TEST__RPN_PRE_NMS_TOP_N=300 --cfg TEST__RPN_POST_NMS_TOP_N=32)
+
+# three free localhost ports: router, member 0, member 1
+read -r RP M0 M1 <<<"$(python - <<'EOF'
+import socket
+socks = [socket.socket() for _ in range(3)]
+for s in socks:
+    s.bind(("127.0.0.1", 0))
+print(" ".join(str(s.getsockname()[1]) for s in socks))
+for s in socks:
+    s.close()
+EOF
+)"
+
+# wait_fleet PORT PID WANT: poll the router's /readyz until the
+# ready-member count reaches WANT (used after boot AND after the
+# fault-phase crash-restart)
+wait_fleet() {
+python - "$1" "$2" "$3" <<'EOF'
+import os, sys, time
+from mx_rcnn_tpu.serve import tcp_http_request
+port, pid, want = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+for _ in range(300):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        sys.exit("router exited before the fleet settled")
+    try:
+        _, doc = tcp_http_request("127.0.0.1", port, "GET", "/readyz",
+                                  timeout=5)
+        if doc.get("ready_members", 0) >= want:
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(1)
+sys.exit(f"fleet never settled at >= {want} ready members")
+EOF
+}
+
+# prom_scrape OUTFILE: the router's Prometheus exposition, curl or stdlib
+prom_scrape() {
+curl -sf "http://127.0.0.1:$RP/metrics?format=prom" >"$1" \
+  || python - "$RP" "$1" <<'EOF'
+import sys
+from mx_rcnn_tpu.serve import tcp_http_request_raw
+status, raw, _ = tcp_http_request_raw(
+    "127.0.0.1", int(sys.argv[1]), "GET", "/metrics?format=prom",
+    headers={"Accept": "text/plain"}, timeout=10)
+assert status == 200, status
+open(sys.argv[2], "wb").write(raw)
+EOF
+}
+
+# ---- act 0: a bad rule pack is a boot error, not a degraded alerter ------
+echo "watch_smoke: [0/4] bad rule pack rejected at boot"
+cat >"$dir/bad_rules.json" <<'EOF'
+{"version": 1, "rules": [{"name": "bad", "kind": "burn_rate",
+ "metric": "m", "fast_window_s": 300, "slow_window_s": 60}]}
+EOF
+if timeout -k 10 180 python serve.py --network resnet50 --fabric \
+     --port "$RP" --alert-rules "$dir/bad_rules.json" \
+     2>"$dir/bad_rules.err"; then
+  echo "watch_smoke: bad rule pack was ACCEPTED" >&2
+  exit 1
+fi
+grep -q "rule 0" "$dir/bad_rules.err"
+echo "watch_smoke: boot refused, error names the rule"
+
+# ---- act 1: fabric up under the default pack, clean traffic fires nothing
+echo "watch_smoke: [1/4] clean pass under the default pack"
+python serve.py --network resnet50 --fabric --port "$RP" \
+  --probe-interval-s 0.5 --telemetry-dir "$tel" \
+  --watch --watch-tick-s 0.5 --trace --trace-sample 1.0 &
+rpid=$!
+mports=("$M0" "$M1")
+mpids=()
+for i in 0 1; do
+  MXR_REPLICA_INDEX=$i python serve.py "${common[@]}" \
+    --port "${mports[i]}" --join "127.0.0.1:$RP" &
+  mpids[i]=$!
+done
+trap 'kill "$rpid" "${mpids[@]}" 2>/dev/null || true' EXIT
+
+wait_fleet "$RP" "$rpid" 2
+
+# the cold boot took >> the rule's 5s hold with zero ready members —
+# if warming counted as stale, member_stale would have paged already;
+# --watch-check (no --watch-expect) asserts the ledger is EMPTY.
+# rate stays under the 2-member CPU capacity (~1.3 req/s): clean
+# traffic must actually be clean — queueing past the 2500ms p99
+# target would legitimately burn the budget
+python scripts/loadgen.py --port "$RP" --n 8 --rate 0.5 \
+  --assert-2xx --watch-check | tee "$dir/clean.json"
+
+# the live surfaces answer: /alerts (7 default rules, nothing firing)
+# and /history (the watchtower's in-process metric ring)
+python scripts/alert_query.py --port "$RP" --live | tee "$dir/live.txt"
+grep -q "7 rule(s)" "$dir/live.txt"
+grep -q "(no alert instances)" "$dir/live.txt"
+python scripts/alert_query.py --port "$RP" --history fleet/ready \
+  --window 300 | tee "$dir/history_clean.txt"
+grep -q "fleet/ready" "$dir/history_clean.txt"
+! grep -q -- "— 0 point(s)" "$dir/history_clean.txt"
+echo "watch_smoke: clean pass OK (nothing fired, live surfaces answer)"
+
+# ---- act 2: crash-restart the fleet DEGRADED → burn the error budget ----
+echo "watch_smoke: [2/4] 8s /predict delay burns the p99 budget"
+kill -KILL "${mpids[@]}" 2>/dev/null || true
+wait "${mpids[@]}" 2>/dev/null || true
+for i in 0 1; do
+  MXR_REPLICA_INDEX=$i MXR_FAULT_NET_DELAY_MS="$i:8000" \
+    python serve.py "${common[@]}" \
+    --port "${mports[i]}" --join "127.0.0.1:$RP" &
+  mpids[i]=$!
+done
+wait_fleet "$RP" "$rpid" 2
+
+# every routed request now takes ~8s against the 2500ms target: the
+# fast/slow burn windows fill and fabric_p99_burn must PAGE before the
+# run ends; the crash itself must have fired-and-resolved member_stale
+python scripts/loadgen.py --port "$RP" --n 80 --rate 2 \
+  --watch-check --watch-expect fabric_p99_burn \
+  --watch-expect member_stale | tee "$dir/fault.json"
+
+# the page is on the wire: mxr_alert_state exposes it to Prometheus
+prom_scrape "$dir/prom.txt"
+grep -q '# HELP mxr_alert_state ' "$dir/prom.txt"
+grep 'mxr_alert_state{alertname="fabric_p99_burn"' "$dir/prom.txt" \
+  | grep -q ' 1$'
+echo "watch_smoke: fabric_p99_burn firing (and exported to Prometheus)"
+
+# ---- act 3: traffic stops → budget stops burning → auto-resolve ---------
+echo "watch_smoke: [3/4] quiet traffic lets the burn alert resolve"
+ok=0
+for _ in $(seq 1 60); do
+  if python scripts/alert_query.py --telemetry-dir "$tel" \
+       --assert fabric_p99_burn=resolved \
+       --require-traces fabric_p99_burn >/dev/null 2>&1; then
+    ok=1
+    break
+  fi
+  sleep 2
+done
+if [ "$ok" != 1 ]; then
+  python scripts/alert_query.py --telemetry-dir "$tel" --list || true
+  python scripts/alert_query.py --telemetry-dir "$tel" \
+    --assert fabric_p99_burn=resolved --require-traces fabric_p99_burn
+fi
+# the forensic surfaces: per-alert timeline + the violation-bit ring
+python scripts/alert_query.py --telemetry-dir "$tel" --list
+python scripts/alert_query.py --telemetry-dir "$tel" fabric_p99_burn \
+  | tee "$dir/timeline.txt"
+grep -q "traces=\[" "$dir/timeline.txt"
+python scripts/alert_query.py --port "$RP" \
+  --history alert/fabric_p99_burn/violation --window 600 \
+  | tee "$dir/history_burn.txt"
+grep -q "max 1" "$dir/history_burn.txt"
+echo "watch_smoke: burn arc resolved, timeline carries trace ids"
+
+# ---- act 4: report + teardown + gate ------------------------------------
+echo "watch_smoke: [4/4] mxr_watch_report through the perf gate"
+python - "$tel" "$dir/clean.json" "$RP" "$dir/WATCH_r01.json" <<'EOF'
+import glob, json, sys
+from mx_rcnn_tpu.serve import tcp_http_request
+tel, clean_path, rp, out = (sys.argv[1], sys.argv[2], int(sys.argv[3]),
+                            sys.argv[4])
+recs = []
+for path in glob.glob(f"{tel}/alerts_*.jsonl"):
+    for line in open(path):
+        line = line.strip()
+        if line:
+            recs.append(json.loads(line))
+fired = [r for r in recs if r.get("state") == "firing"]
+resolved = [r for r in recs if r.get("state") == "resolved"]
+burn = [r for r in fired if r.get("alert") == "fabric_p99_burn"]
+assert burn, "fabric_p99_burn never fired"
+trace_ids = sorted({t for r in burn for t in r.get("trace_ids") or []})
+assert trace_ids, "the burn page carried no trace ids"
+clean = json.load(open(clean_path))
+clean_fired = len((clean.get("alerts") or {}).get("fired") or [])
+status, doc = tcp_http_request("127.0.0.1", rp, "GET", "/alerts",
+                               timeout=10)
+assert status == 200, status
+assert not doc["firing"], f"still firing at end: {doc['firing']}"
+c = doc["counters"]
+report = {"schema": "mxr_watch_report", "version": 1,
+          "clean_fired": clean_fired,
+          "firing_at_end": len(doc["firing"]),
+          "rule_errors": c["rule_errors"],
+          "fault_fired": len(fired),
+          "fault_resolved": len(resolved),
+          "fault_trace_ids": len(trace_ids),
+          "transitions": c["transitions"],
+          "rules": doc["rules"], "ticks": doc["ticks"],
+          "alerts_fired": sorted({r["alert"] for r in fired})}
+json.dump(report, open(out, "w"), indent=1, sort_keys=True)
+print(f"watch_smoke: report OK (fired={report['alerts_fired']}, "
+      f"trace_ids={len(trace_ids)}, transitions={c['transitions']}, "
+      f"rule_errors={c['rule_errors']})")
+EOF
+
+kill -TERM "${mpids[@]}" "$rpid"
+wait "$rpid" || true
+wait "${mpids[@]}" || true
+trap - EXIT
+
+# every transition is first-class telemetry: alert_transition meta
+# events in the stream, and the firing page dumped the flight ring
+python - "$tel" <<'EOF'
+import glob, json, sys
+events = []
+for path in glob.glob(f"{sys.argv[1]}/events_rank*.jsonl"):
+    for line in open(path):
+        events.append(json.loads(line))
+trans = [e for e in events if e.get("kind") == "meta"
+         and e.get("name") == "alert_transition"]
+states = {(e["fields"]["alert"], e["fields"]["state"]) for e in trans}
+for want in (("fabric_p99_burn", "firing"),
+             ("fabric_p99_burn", "resolved")):
+    assert want in states, (want, sorted(states))
+dumps = [e for e in events if e.get("kind") == "meta"
+         and e.get("name") == "flight_trigger"
+         and e.get("fields", {}).get("reason") == "alert_firing"]
+assert dumps, "no alert_firing flight dump in the stream"
+assert glob.glob(f"{sys.argv[1]}/flight_*.jsonl"), "no flight file"
+print(f"watch_smoke: telemetry OK ({len(trans)} alert_transition "
+      f"event(s), {len(dumps)} flight dump(s))")
+EOF
+
+# the report table folds the alert ledger in (the "alerts" section)
+python scripts/telemetry_report.py "$tel" | tee "$dir/table.txt"
+grep -q "fabric_p99_burn" "$dir/table.txt"
+
+# ---- perf gate -----------------------------------------------------------
+python scripts/perf_gate.py --check-format "$dir"/WATCH_r*.json
+python scripts/perf_gate.py --dir "$dir"
+echo "watch_smoke: OK"

@@ -1,0 +1,352 @@
+#!/usr/bin/env python
+"""Query watchtower alerts: the lifecycle log, the live endpoint, and
+metric-history sparklines.
+
+  python scripts/alert_query.py --telemetry-dir /tmp/t --list
+  python scripts/alert_query.py --telemetry-dir /tmp/t fabric_p99_burn
+  python scripts/alert_query.py --port 8320 --live
+  python scripts/alert_query.py --port 8320 --history fabric/route_time
+  python scripts/alert_query.py --telemetry-dir /tmp/t \\
+      --assert fabric_p99_burn=resolved --require-traces fabric_p99_burn
+
+Offline mode folds every ``alerts_<member>.jsonl`` under
+``--telemetry-dir`` (the watchtower's atomic transition log,
+telemetry/watch.py) and prints per-alert timelines: each
+pending→firing→resolved transition with its value, hold/firing
+durations, and the tail trace ids the firing transition attached — the
+join point into ``scripts/trace_query.py`` ("this alert fired; here are
+the slow traces from the same window").
+
+Live mode (--host/--port or --unix-socket against a serve.py --watch
+process) prints the ``/alerts`` document — firing / pending / silenced
+/ resolved instances plus active silences — and ``--history METRIC``
+renders the watchtower's in-process metric ring for one series as a
+unicode sparkline over ``--window`` seconds.
+
+Assertions for smoke scripts: ``--assert NAME=STATE`` (repeatable)
+exits 1 unless the LATEST transition of NAME is STATE — so
+``--assert fabric_p99_burn=resolved`` pins the full fire-then-recover
+arc; ``--require-traces NAME`` exits 1 unless some firing transition of
+NAME carried at least one trace id (the alert→trace join the flight
+dump relies on).  Pure stdlib — no jax, no numpy; safe anywhere the
+telemetry dir is mounted.
+"""
+
+import argparse
+import glob
+import http.client
+import json
+import os
+import socket
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mx_rcnn_tpu.telemetry.watch import ALERTS_PREFIX  # noqa: E402
+
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def load_transitions(telemetry_dir):
+    """Every ``kind: "alert"`` record under the dir, time-ordered.
+    Torn lines are skipped, not fatal — the log is rewritten atomically
+    but a query against a live run must not die on a race."""
+    recs = []
+    pattern = os.path.join(telemetry_dir, f"{ALERTS_PREFIX}*.jsonl")
+    for path in sorted(glob.glob(pattern)):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("kind") == "alert":
+                    recs.append(rec)
+    recs.sort(key=lambda r: float(r.get("t", 0.0)))
+    return recs
+
+
+def by_alert(recs):
+    out = {}
+    for rec in recs:
+        out.setdefault(str(rec.get("alert", "?")), []).append(rec)
+    return out
+
+
+def latest_state(recs):
+    """The alert's current state: the latest transition per fingerprint,
+    with 'firing' winning over anything else across instances (one
+    member still firing means the alert is firing)."""
+    last = {}
+    for rec in recs:
+        last[rec.get("fingerprint", "?")] = str(rec.get("state", "?"))
+    states = set(last.values())
+    for state in ("firing", "pending", "resolved"):
+        if state in states:
+            return state
+    return next(iter(states), "?")
+
+
+def trace_ids_of(recs):
+    ids = []
+    for rec in recs:
+        for tid in rec.get("trace_ids") or []:
+            if tid not in ids:
+                ids.append(tid)
+    return ids
+
+
+def summary_line(name, recs):
+    states = [str(r.get("state", "?")) for r in recs]
+    firing_s = sum(float(r.get("firing_s", 0.0)) for r in recs
+                   if isinstance(r.get("firing_s"), (int, float)))
+    members = sorted({str(r.get("member", "?")) for r in recs})
+    return (f"{name} [{recs[0].get('severity', '?')}] — "
+            f"{latest_state(recs)}; {len(recs)} transition(s) "
+            f"(fired {states.count('firing')}, resolved "
+            f"{states.count('resolved')}), {firing_s:.2f}s firing, "
+            f"member(s): {','.join(members)}")
+
+
+def format_labels(labels):
+    return ",".join(f"{k}={v}" for k, v in sorted((labels or {}).items()))
+
+
+def render_timeline(name, recs, out):
+    t0 = float(recs[0].get("t", 0.0))
+    for rec in recs:
+        parts = [f"  +{float(rec.get('t', 0.0)) - t0:9.2f}s",
+                 f"{rec.get('state', '?'):<9}",
+                 f"[{rec.get('member', '?')}]"]
+        labels = format_labels(rec.get("labels"))
+        if labels:
+            parts.append(labels)
+        v = rec.get("value")
+        if isinstance(v, (int, float)):
+            parts.append(f"value={v:g}")
+        for key in ("held_s", "firing_s"):
+            if isinstance(rec.get(key), (int, float)):
+                parts.append(f"{key}={rec[key]:g}")
+        if rec.get("silenced"):
+            parts.append("silenced")
+        traces = rec.get("trace_ids") or []
+        if traces:
+            parts.append(f"traces=[{','.join(t[:8] for t in traces)}]")
+        out.append(" ".join(parts))
+
+
+def http_get_json(args, path):
+    """``(status, doc)`` for GET ``path`` against the live target;
+    raises SystemExit on connection failure (a live query against a
+    dead server is an operator error worth a clean message)."""
+    try:
+        if args.unix_socket:
+            conn = _UnixConn(args.unix_socket, args.timeout)
+        else:
+            conn = http.client.HTTPConnection(args.host, args.port,
+                                              timeout=args.timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+            doc = json.loads(body) if body else {}
+            return resp.status, doc
+        finally:
+            conn.close()
+    except (OSError, ValueError) as e:
+        target = args.unix_socket or f"{args.host}:{args.port}"
+        raise SystemExit(f"alert_query: {target}{path} unreachable "
+                         f"({e})")
+
+
+class _UnixConn(http.client.HTTPConnection):
+    def __init__(self, sock_path, timeout):
+        super().__init__("localhost", timeout=timeout)
+        self._sock_path = sock_path
+
+    def connect(self):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        s.connect(self._sock_path)
+        self.sock = s
+
+
+def sparkline(values, width=60):
+    """Min-max normalized unicode sparkline, downsampled to ``width``
+    by taking the max of each chunk (spikes must stay visible)."""
+    if not values:
+        return "(no points)"
+    if len(values) > width:
+        chunk = len(values) / width
+        values = [max(values[int(i * chunk):
+                             max(int((i + 1) * chunk), int(i * chunk) + 1)])
+                  for i in range(width)]
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    return "".join(SPARK_BLOCKS[min(int((v - lo) / span
+                                        * (len(SPARK_BLOCKS) - 1)),
+                                    len(SPARK_BLOCKS) - 1)]
+                   for v in values)
+
+
+def render_live(doc, out):
+    out.append(f"member {doc.get('member', '?')} — "
+               f"{doc.get('rules', 0)} rule(s), "
+               f"{doc.get('ticks', 0)} tick(s)")
+    for section in ("firing", "pending", "silenced"):
+        for inst in doc.get(section) or []:
+            labels = format_labels(inst.get("labels"))
+            line = (f"  {section:<9} {inst.get('alert', '?')} "
+                    f"[{inst.get('severity', '?')}] "
+                    f"since {inst.get('since_s', 0.0):g}s "
+                    f"value={inst.get('value')}")
+            if labels:
+                line += f" {labels}"
+            traces = inst.get("trace_ids") or []
+            if traces:
+                line += f" traces=[{','.join(t[:8] for t in traces)}]"
+            out.append(line)
+    for inst in doc.get("resolved") or []:
+        out.append(f"  resolved  {inst.get('alert', '?')} "
+                   f"[{inst.get('severity', '?')}] "
+                   f"{inst.get('age_s', 0.0):g}s ago "
+                   f"(fired {inst.get('firing_s', 0.0):g}s)")
+    for s in doc.get("silences") or []:
+        out.append(f"  silence   {s.get('alertname', '?')} "
+                   f"expires in {s.get('expires_in_s', 0.0):g}s "
+                   f"(id {s.get('id', '?')})")
+    if not any(doc.get(k) for k in ("firing", "pending", "silenced",
+                                    "resolved", "silences")):
+        out.append("  (no alert instances)")
+
+
+def run_asserts(grouped, asserts, require_traces):
+    """The smoke-script exit-code surface; returns failure lines."""
+    failures = []
+    for spec in asserts:
+        name, sep, state = spec.partition("=")
+        if not sep:
+            raise SystemExit(f"alert_query: --assert is NAME=STATE, "
+                             f"got {spec!r}")
+        recs = grouped.get(name)
+        if not recs:
+            failures.append(f"{name}: no transitions on disk "
+                            f"(expected latest state {state!r})")
+        elif latest_state(recs) != state:
+            failures.append(f"{name}: latest state is "
+                            f"{latest_state(recs)!r}, expected {state!r}")
+    for name in require_traces:
+        recs = grouped.get(name, [])
+        fired = [r for r in recs if r.get("state") == "firing"]
+        if not fired:
+            failures.append(f"{name}: never fired (no trace ids to "
+                            f"check)")
+        elif not trace_ids_of(fired):
+            failures.append(f"{name}: fired with ZERO trace ids "
+                            f"attached (tracing off on the member?)")
+    return failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("alerts", nargs="*",
+                    help="alertname(s) to print timelines for (offline "
+                         "mode; default: every alert seen)")
+    ap.add_argument("--telemetry-dir", default="", dest="telemetry_dir",
+                    help="dir holding alerts_<member>.jsonl (offline "
+                         "transition-log mode)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--unix-socket", default="", dest="unix_socket",
+                    help="live target over a Unix socket instead of TCP")
+    ap.add_argument("--live", action="store_true",
+                    help="print the live /alerts document")
+    ap.add_argument("--history", default="", metavar="METRIC",
+                    help="live mode: sparkline this metric from the "
+                         "watchtower's /history ring")
+    ap.add_argument("--window", type=float, default=300.0,
+                    help="--history window in seconds")
+    ap.add_argument("--list", action="store_true", dest="list_all",
+                    help="offline mode: one summary line per alert")
+    ap.add_argument("--assert", action="append", default=[],
+                    dest="asserts", metavar="NAME=STATE",
+                    help="exit 1 unless NAME's latest transition is "
+                         "STATE (repeatable; offline mode)")
+    ap.add_argument("--require-traces", action="append", default=[],
+                    dest="require_traces", metavar="NAME",
+                    help="exit 1 unless a firing transition of NAME "
+                         "carried at least one trace id (repeatable)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    live_target = bool(args.unix_socket or args.port)
+    if args.history:
+        if not live_target:
+            raise SystemExit("alert_query: --history needs a live "
+                             "target (--port/--unix-socket)")
+        from urllib.parse import quote
+        status, doc = http_get_json(
+            args, f"/history?metric={quote(args.history, safe='')}"
+                  f"&window={args.window:g}")
+        if status != 200:
+            raise SystemExit(f"alert_query: /history → {status} "
+                             f"({doc.get('error', 'watchtower off?')})")
+        vals = [p[1] for p in doc.get("points") or []]
+        print(f"{doc.get('metric', args.history)} over last "
+              f"{args.window:g}s — {len(vals)} point(s), "
+              f"min {doc.get('min', 0):g} max {doc.get('max', 0):g} "
+              f"last {doc.get('last', 0):g}")
+        print(f"  {sparkline(vals)}")
+        return
+
+    if args.live or (live_target and not args.telemetry_dir):
+        if not live_target:
+            raise SystemExit("alert_query: --live needs "
+                             "--port/--unix-socket")
+        status, doc = http_get_json(args, "/alerts")
+        if status != 200:
+            raise SystemExit(f"alert_query: /alerts → {status} "
+                             f"(serve.py --watch not active?)")
+        lines = []
+        render_live(doc, lines)
+        print("\n".join(lines))
+        return
+
+    if not args.telemetry_dir:
+        raise SystemExit("alert_query: pass --telemetry-dir (offline "
+                         "log mode) or --port/--unix-socket (live mode)")
+    recs = load_transitions(args.telemetry_dir)
+    grouped = by_alert(recs)
+    if args.asserts or args.require_traces:
+        failures = run_asserts(grouped, args.asserts,
+                               args.require_traces)
+        for f in failures:
+            print(f"alert_query: ASSERT {f}", file=sys.stderr)
+        if failures:
+            sys.exit(1)
+        print(f"alert_query: {len(args.asserts)} assert(s) + "
+              f"{len(args.require_traces)} trace requirement(s) OK")
+        return
+    if not grouped:
+        raise SystemExit(f"alert_query: no alert transitions under "
+                         f"{args.telemetry_dir} (watchtower off, or "
+                         f"nothing ever alerted?)")
+    if args.list_all:
+        for name in sorted(grouped):
+            print(summary_line(name, grouped[name]))
+        return
+    chosen = args.alerts or sorted(grouped)
+    for name in chosen:
+        if name not in grouped:
+            raise SystemExit(f"alert_query: no transitions for {name!r} "
+                             f"(have: {', '.join(sorted(grouped))})")
+        lines = [summary_line(name, grouped[name])]
+        render_timeline(name, grouped[name], lines)
+        print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()

@@ -141,6 +141,13 @@ imgs/s, floored by ``--speedup-floor``, default 1.0 — the cascade must
 not LOSE to always-big), ``--agreement-floor`` (mean agreement floor),
 and ``--throughput-floor`` (absolute imgs/s floor) — what
 ``perf_gate.py`` scores on CASCADE_r*.json.
+
+``--watch-check`` (ISSUE 20, script/watch_smoke.sh): scrape the
+target's ``/alerts`` (a serve.py --watch process) after the run and
+assert the alert set — no ``--watch-expect`` means NOTHING may have
+fired (the clean-traffic contract); each ``--watch-expect NAME`` must
+have fired, and nothing outside the expected set may still be firing.
+Each scenario summary gains an ``alerts`` block either way.
 """
 
 import argparse
@@ -314,6 +321,23 @@ def parse_args(argv=None):
                          "detection agreement between the cascade's "
                          "answers and the big model's on the same "
                          "images (0 = no pin)")
+    ap.add_argument("--watch-check", action="store_true",
+                    dest="watch_check",
+                    help="scrape the target's /alerts after the run and "
+                         "assert the alert set matches expectations: "
+                         "with no --watch-expect nothing may have fired "
+                         "at all (the clean-traffic contract — a "
+                         "fire-then-resolve during a steady run is "
+                         "still an SLO breach); each --watch-expect "
+                         "NAME must have fired (firing now or resolved "
+                         "in the history), and nothing outside the "
+                         "expected set may still be firing.  Exit 1 "
+                         "with the mismatch on stderr; an 'alerts' "
+                         "block joins each scenario summary")
+    ap.add_argument("--watch-expect", action="append", default=[],
+                    dest="watch_expect", metavar="NAME",
+                    help="--watch-check: this alertname must have fired "
+                         "by the end of the run (repeatable)")
     ap.add_argument("--trace-sample", type=float, default=0.0,
                     dest="trace_sample",
                     help="fraction of requests that carry a client-minted"
@@ -605,6 +629,73 @@ def trace_stats(args, timeout=10.0):
         return {}
     return {k: int(tr.get(k, 0))
             for k in ("spans_emitted", "tail_kept")}
+
+
+def watch_alerts_doc(args, timeout=10.0):
+    """The target's ``/alerts`` document (a serve.py --watch process),
+    ``{}`` when the route is absent (watchtower off there) or the
+    target is unreachable."""
+    try:
+        if args.unix_socket:
+            status, doc = unix_http_request(args.unix_socket, "GET",
+                                            "/alerts", timeout=timeout)
+        else:
+            conn = http.client.HTTPConnection(args.host, args.port,
+                                              timeout=timeout)
+            try:
+                conn.request("GET", "/alerts")
+                resp = conn.getresponse()
+                status, doc = resp.status, json.loads(resp.read())
+            finally:
+                conn.close()
+    except (OSError, ValueError):
+        return {}
+    return doc if status == 200 and isinstance(doc, dict) else {}
+
+
+def watch_alert_names(doc):
+    """``(firing_names, fired_names)`` from an ``/alerts`` doc — fired
+    covers both currently-firing and already-resolved instances (and
+    silenced ones that reached the firing state: a silence hides the
+    page, not the fact)."""
+    firing = sorted({a.get("alert", "?")
+                     for a in (doc.get("firing") or [])})
+    fired = sorted({a.get("alert", "?")
+                    for a in (doc.get("firing") or [])
+                    + (doc.get("resolved") or [])
+                    + [a for a in (doc.get("silenced") or [])
+                       if a.get("state") == "firing"]})
+    return firing, fired
+
+
+def watch_check_failure(doc, expected):
+    """None when the target's alert state matches ``expected`` (the
+    --watch-expect alertnames), else the stderr failure line.  No
+    expectations ⇒ the clean-traffic contract: nothing may have fired
+    at all.  With expectations: every named alert must have fired, and
+    nothing OUTSIDE the expected set may still be firing (a leftover
+    firing alert means the injected fault never cleared).  A target
+    with no /alerts route fails loudly — pointing --watch-check at a
+    watch-off server is itself a smoke-script bug."""
+    if not doc:
+        return ("loadgen: --watch-check failed: target exposes no "
+                "/alerts route (serve.py --watch not active?)")
+    firing, fired = watch_alert_names(doc)
+    if not expected:
+        if fired:
+            return (f"loadgen: --watch-check failed: expected a clean "
+                    f"pass but {fired} fired (still firing: "
+                    f"{firing or '[]'})")
+        return None
+    missing = sorted(set(expected) - set(fired))
+    if missing:
+        return (f"loadgen: --watch-check failed: expected {missing} to "
+                f"fire; fired: {fired or '[]'}")
+    stray = sorted(set(firing) - set(expected))
+    if stray:
+        return (f"loadgen: --watch-check failed: {stray} still firing "
+                f"beyond the expected set {sorted(set(expected))}")
+    return None
 
 
 def trace_echo_failure(results):
@@ -1342,6 +1433,12 @@ def main(argv=None):
             out["traced"] = sum(1 for d in docs if "trace" in d)
             out["tail_kept"] = trace_stats(
                 args, timeout=args.timeout).get("tail_kept")
+        if args.watch_check:
+            wdoc = watch_alerts_doc(args, timeout=args.timeout)
+            firing, fired = watch_alert_names(wdoc)
+            out["alerts"] = ({"firing": firing, "fired": fired,
+                              "ticks": wdoc.get("ticks")}
+                             if wdoc else None)
         if scenario is not None:
             out = {"scenario": scenario, **out}
         if scenario is not None or args.report:
@@ -1354,7 +1451,7 @@ def main(argv=None):
                          "profile", "schedule", "fleet", "time_to_scale_s",
                          "recompiles_during_run", "p99_ceiling_ms",
                          "scale_floor", "time_to_scale_ceiling_s",
-                         "recompile_ceiling")}})
+                         "recompile_ceiling", "alerts")}})
         print(json.dumps(out))
 
     if args.report:
@@ -1375,6 +1472,14 @@ def main(argv=None):
 
     if args.trace_sample > 0:
         msg = trace_echo_failure(all_results)
+        if msg is not None:
+            print(msg, file=sys.stderr)
+            sys.exit(1)
+
+    if args.watch_check:
+        msg = watch_check_failure(
+            watch_alerts_doc(args, timeout=args.timeout),
+            args.watch_expect)
         if msg is not None:
             print(msg, file=sys.stderr)
             sys.exit(1)

@@ -489,6 +489,38 @@ def cascade_report_rows(doc: dict) -> list:
     return rows
 
 
+def watch_report_rows(doc: dict) -> list:
+    """Expand an ``mxr_watch_report`` (script/watch_smoke.sh) into rows.
+    The ISSUE-20 properties are all absolute, scored on the newest run
+    alone: the clean-traffic pass must fire NOTHING (ceiling 0), the
+    fault phase must actually fire and then resolve (floors — an alert
+    pipeline that misses an injected SLO burn is worse than none), a
+    firing alert must have carried trace ids into its flight dump, and
+    nothing may still be firing when the run ends (ceiling 0 — a stuck
+    alert is a broken lifecycle, not a noisy one).  rule_errors gets a
+    zero ceiling: the default pack must evaluate cleanly every tick."""
+    rows = []
+    for field, metric, dialect, default in (
+            ("clean_fired", "watch_clean_fired", "ceiling", 0.0),
+            ("firing_at_end", "watch_firing_at_end", "ceiling", 0.0),
+            ("rule_errors", "watch_rule_errors", "ceiling", 0.0),
+            ("fault_fired", "watch_fault_fired", "floor", 1.0),
+            ("fault_resolved", "watch_fault_resolved", "floor", 1.0),
+            ("fault_trace_ids", "watch_fault_trace_ids", "floor", 1.0)):
+        v = doc.get(field)
+        if isinstance(v, (int, float)):
+            bound = doc.get(f"{field}_{dialect}", default)
+            rows.append({"metric": metric, "value": float(v),
+                         "unit": "alerts", dialect: float(bound)})
+    transitions = doc.get("transitions")
+    if isinstance(transitions, (int, float)):
+        # validated ride-along: total transition volume scales with run
+        # length, so it trends informationally rather than gating
+        rows.append({"metric": "watch_transitions",
+                     "value": float(transitions), "unit": "transitions"})
+    return rows
+
+
 def load_rows(path: str) -> list:
     """Extract metric rows from one trajectory artifact.  Shapes seen in
     the wild: the driver's ``{"n", "cmd", "rc", "tail", "parsed"}`` wrapper
@@ -514,6 +546,8 @@ def load_rows(path: str) -> list:
         return autoscale_report_rows(doc)
     if isinstance(doc, dict) and doc.get("schema") == "mxr_cascade_report":
         return cascade_report_rows(doc)
+    if isinstance(doc, dict) and doc.get("schema") == "mxr_watch_report":
+        return watch_report_rows(doc)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return startup_rows([doc["parsed"]])
     if isinstance(doc, dict) and "metric" in doc:
@@ -731,13 +765,14 @@ def main(argv=None) -> int:
                          "+ --dir/STREAM_r*.json + "
                          "--dir/MULTIMODEL_r*.json + "
                          "--dir/AUTOSCALE_r*.json + "
-                         "--dir/CASCADE_r*.json)")
+                         "--dir/CASCADE_r*.json + --dir/WATCH_r*.json)")
     ap.add_argument("--dir", default=".",
                     help="where to glob BENCH_r*.json / SLO_r*.json / "
                          "REPLICA_r*.json / FABRIC_r*.json / "
                          "FLYWHEEL_r*.json / STREAM_r*.json / "
                          "MULTIMODEL_r*.json / AUTOSCALE_r*.json / "
-                         "CASCADE_r*.json when no paths given")
+                         "CASCADE_r*.json / WATCH_r*.json when no paths "
+                         "given")
     ap.add_argument("--threshold", type=float, default=GATE_THRESHOLD,
                     help="allowed fractional drop vs the best prior run "
                          "(default 0.10)")
@@ -756,7 +791,8 @@ def main(argv=None) -> int:
         + sorted(glob.glob(os.path.join(args.dir, "STREAM_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "MULTIMODEL_r*.json")))
         + sorted(glob.glob(os.path.join(args.dir, "AUTOSCALE_r*.json")))
-        + sorted(glob.glob(os.path.join(args.dir, "CASCADE_r*.json"))))
+        + sorted(glob.glob(os.path.join(args.dir, "CASCADE_r*.json")))
+        + sorted(glob.glob(os.path.join(args.dir, "WATCH_r*.json"))))
     if not paths:
         print("perf_gate: no BENCH_*.json / SLO_*.json files found",
               file=sys.stderr)

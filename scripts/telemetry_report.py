@@ -50,6 +50,13 @@ Run dirs also expand distributed-trace span streams
 section appears, and ``--trace out.json`` folds the cross-hop spans
 into per-member Perfetto process groups with flow arrows linking each
 trace id across hops (per-trace forensics: scripts/trace_query.py).
+
+Run dirs also expand watchtower transition logs
+(``alerts_<member>.jsonl``, a serve.py --watch run): an "alerts"
+section appears — per alertname, how often it went pending / firing /
+resolved / silenced and the total time spent firing, cross-member —
+so "what paged, how often, for how long" reads off one table
+(per-alert forensics: scripts/alert_query.py).
 """
 
 import argparse

@@ -315,6 +315,29 @@ def parse_args():
                         dest="trace_tail_budget",
                         help="kept slow/errored span trees in the tail "
                              "ring (oldest evicted beyond this)")
+    # -- watchtower alerting (ISSUE 20) — OFF by default: without
+    # --watch/--alert-rules no Watchtower is ever constructed — no
+    # monitor thread, no metric-history ring, and /metrics + the
+    # telemetry JSONL stream are byte-for-byte the watch-off output
+    parser.add_argument("--watch", action="store_true",
+                        help="run the watchtower: evaluate the alert-rule "
+                             "pack (telemetry/rules_default.json unless "
+                             "--alert-rules) against live telemetry every "
+                             "--watch-tick-s — SLO error-budget burn "
+                             "rates, thresholds, absence, trends; alerts "
+                             "surface on /alerts, /metrics "
+                             "(mxr_alert_state), and alerts_<member>."
+                             "jsonl, and a newly-firing alert "
+                             "flight-dumps with recent tail trace ids "
+                             "attached.  On the fabric router, rules with "
+                             "scope=fleet evaluate per member")
+    parser.add_argument("--alert-rules", default="", dest="alert_rules",
+                        help="alert-rule pack JSON to evaluate (implies "
+                             "--watch); a bad pack is a clean boot error "
+                             "naming the offending rule")
+    parser.add_argument("--watch-tick-s", type=float, default=1.0,
+                        dest="watch_tick_s",
+                        help="watchtower evaluation tick period")
     return parser.parse_args()
 
 
@@ -338,6 +361,32 @@ def _configure_tracing(args, member: str, rank: int = 0) -> None:
                     member, out_dir, args.trace_sample)
     elif tracectx.configure_from_env(member=member, rank=rank) is not None:
         atexit.register(tracectx.shutdown)
+
+
+def _build_watch(args, member: str, **providers):
+    """--watch/--alert-rules → a started :class:`Watchtower` for this
+    process, else None — and None means NOTHING was constructed: no
+    monitor thread, no history ring, no alert log.  ``providers`` are
+    the per-mode sampling closures (summary_fn/hists_fn on an engine
+    process, fleet_fn/summaries_fn on the fabric router).  A bad rule
+    pack is a clean boot error naming the offending rule."""
+    if not (getattr(args, "watch", False) or
+            getattr(args, "alert_rules", "")):
+        return None
+    from mx_rcnn_tpu.telemetry.watch import (RuleError, WatchOptions,
+                                             Watchtower, load_rules)
+
+    try:
+        rules = (load_rules(args.alert_rules) if args.alert_rules
+                 else None)
+        watch = Watchtower(
+            rules=rules, member=member,
+            opts=WatchOptions(interval_s=args.watch_tick_s),
+            out_dir=args.telemetry_dir or None, **providers)
+    except (RuleError, ValueError, OSError) as e:
+        raise SystemExit(f"--alert-rules: {e}")
+    watch.start()
+    return watch
 
 
 def parse_model_specs(models: str, model_args) -> list:
@@ -582,9 +631,18 @@ def main_single(args):
                                     interval_s=args.watch_interval_s)
         watcher.start()
 
+    # watchtower over THIS engine: summary counters/gauges feed the
+    # history ring, the engine's live latency hists feed burn rules
+    from mx_rcnn_tpu.telemetry.obs import engine_summary
+    watch = _build_watch(
+        args, "server",
+        summary_fn=lambda: engine_summary(engine),
+        hists_fn=lambda: {**telemetry.get().live_hists(),
+                          **engine.latency_hists()})
+
     server = make_server(engine, port=args.port or None, host=args.host,
                          unix_socket=args.unix_socket or None,
-                         stream=stream)
+                         stream=stream, watch=watch)
     # serve_forever on a worker thread; the main thread parks on an event
     # the signal handlers set — shutdown() called from the serving thread
     # itself would deadlock its poll loop
@@ -600,12 +658,17 @@ def main_single(args):
     done.wait()
     logger.info("shutting down: %s", engine.metrics()["counters"])
     server.shutdown()
+    if watch is not None:
+        watch.stop()  # no alert churn from the drain itself
     if watcher is not None:
         watcher.stop()
     if controller is not None:
         controller.stop()
     engine.stop()
-    obs.close(extra={"serve": engine.metrics()})
+    extra = {"serve": engine.metrics()}
+    if watch is not None:
+        extra["watch"] = watch.state()
+    obs.close(extra=extra)
 
 
 def main_multimodel(args):
@@ -823,6 +886,26 @@ def main_fabric(args):
                 target_depth=args.autoscale_target_depth,
                 interval_s=args.autoscale_interval_s)).start()
         router.autoscaler = authority
+    # watchtower over the FLEET: the pool folds to the per-member view
+    # (absence/threshold rules), peer telemetry snapshots feed
+    # fleet-scoped burn rules, and the router's own fabric/route_time
+    # hist (observed only while a watchtower is attached) feeds local
+    # burn rules on routed latency
+    watch = None
+    if args.watch or args.alert_rules:
+        from mx_rcnn_tpu.telemetry.obs import read_peer_snapshots
+        from mx_rcnn_tpu.telemetry.watch import fleet_from_pool
+
+        summaries_fn = None
+        if args.telemetry_dir:
+            tdir = args.telemetry_dir
+            summaries_fn = (lambda: {
+                f"rank{r}": s
+                for r, s in read_peer_snapshots(tdir)[0].items()})
+        watch = _build_watch(args, "router",
+                             fleet_fn=lambda: fleet_from_pool(pool),
+                             summaries_fn=summaries_fn)
+        router.watchtower = watch
     server = make_fabric_server(router, port=args.port or None,
                                 host=args.host,
                                 unix_socket=args.unix_socket or None)
@@ -842,6 +925,8 @@ def main_fabric(args):
     done.wait()
     logger.info("fabric shutting down: %s", pool.counters)
     server.shutdown()
+    if watch is not None:
+        watch.stop()  # no alert churn from the drain itself
     if authority is not None:
         authority.stop()  # no scale decisions during teardown
     if watcher is not None:
@@ -852,6 +937,8 @@ def main_fabric(args):
     extra = {"fabric": pool.metrics()}
     if authority is not None:
         extra["autoscale"] = authority.state()
+    if watch is not None:
+        extra["watch"] = watch.state()
     obs.close(extra=extra)
 
 

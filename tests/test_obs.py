@@ -105,6 +105,60 @@ def test_gauge_summary_extremes_feed_the_endpoint(tmp_path):
     assert 'mxr_loader_queue_depth{rank="0",stat="last"} 1.0' in text
 
 
+def _lint_exposition(text):
+    """Prometheus exposition lint (ISSUE 20 satellite): every sampled
+    ``mxr_*`` family must declare ``# HELP`` then ``# TYPE`` exactly
+    once, both before the family's first sample."""
+    helped, typed, sampled = set(), set(), set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            fam = line.split()[2]
+            assert fam not in helped, f"duplicate HELP for {fam}"
+            assert fam not in sampled, f"HELP after samples for {fam}"
+            helped.add(fam)
+        elif line.startswith("# TYPE "):
+            fam = line.split()[2]
+            assert fam not in typed, f"duplicate TYPE for {fam}"
+            assert fam in helped, f"TYPE before HELP for {fam}"
+            typed.add(fam)
+        elif not line.startswith("#"):
+            fam = line.split("{", 1)[0].split(" ", 1)[0]
+            if fam not in typed:
+                # histogram samples hang off the base family's TYPE
+                base = fam.rsplit("_", 1)[0]
+                assert (fam.endswith(("_bucket", "_sum", "_count"))
+                        and base in typed), \
+                    f"sample before TYPE for {fam}"
+                fam = base
+            sampled.add(fam)
+    assert sampled, "exposition rendered no samples at all"
+
+
+def test_exposition_lint_every_family_has_help_and_type(tmp_path):
+    tel = Telemetry(str(tmp_path), rank=0)
+    tel.counter("train/steps", 7)
+    tel.counter("serve/requests", 3)
+    tel.gauge("loader/queue_depth", 2.0)
+    tel.observe("serve/request_time", 0.05)
+    with tel.span("train/dispatch"):
+        pass
+    text = prometheus_text({0: tel.summary()}, ages={0: 0.5})
+    tel.close()
+    _lint_exposition(text)
+    # the appended mxr_alert_state family (serve_prometheus /
+    # fabric_prometheus with a watchtower attached) lints the same way
+    from mx_rcnn_tpu.telemetry.watch import Watchtower, alert_state_lines
+
+    wt = Watchtower(rules=[{"name": "hot", "kind": "threshold",
+                            "metric": "m", "op": ">", "value": 1.0}],
+                    summary_fn=lambda: {"gauges": {"m": {"last": 5.0}}})
+    wt.tick(now=0.0)
+    _lint_exposition(text + "\n".join(alert_state_lines(wt, now=0.0))
+                     + "\n")
+
+
 # -- obs server + cross-rank fold ------------------------------------------
 
 
